@@ -1,0 +1,33 @@
+"""distributed_deep_learning_tpu — a TPU-native distributed deep-learning framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the reference
+``Belegkarnil/distributed-deep-learning`` benchmark harness (multi-framework
+distributed-training workloads: MLP / DenseNet-BC CNN / CNN-LSTM under
+sequential, model-parallel, pipelined and data-parallel execution), built
+TPU-first:
+
+* one compiled program per training step (``jax.jit``), not an eager loop;
+* parallelism expressed as shardings over a named ``jax.sharding.Mesh``
+  (axes: ``data``, ``stage``, ``model``, ``seq``, ``expert``) with XLA
+  collectives over ICI/DCN — not NCCL/MPI process groups;
+* pipeline parallelism as an SPMD ``shard_map`` + ``lax.ppermute`` schedule,
+  not a Python microbatch loop;
+* host-side batched input pipelines feeding device-sharded arrays, not
+  per-item ``.to(device)`` copies.
+
+Subpackages
+-----------
+``utils``     config/CLI, logging, PRNG discipline
+``runtime``   mesh construction, multi-host bootstrap, device placement
+``data``      dataset semantics of the three reference workloads + loaders
+``models``    Flax model zoo (MLP, DenseNet-BC, CNN-LSTM, ResNet, Transformer…)
+``parallel``  partitioners, DP/MP/PP/TP/SP strategies, collectives
+``ops``       Pallas TPU kernels for the hot ops
+``train``     jitted train/eval steps, the epoch loop, metrics, checkpointing
+"""
+
+__version__ = "0.1.0"
+
+# Keep the top-level import cheap: subpackages import jax lazily enough that
+# `import distributed_deep_learning_tpu` never triggers device initialisation.
+from distributed_deep_learning_tpu.utils.config import Config, Mode  # noqa: F401

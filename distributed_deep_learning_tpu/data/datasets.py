@@ -1,0 +1,74 @@
+"""In-memory array datasets + synthetic generators for the three workloads.
+
+The reference's datasets are small enough to live in host RAM (PCB: ~4.8k
+images; PdM: 875,900 rows; MQTT: one CSV) — its mistake was *per-item* device
+transfer inside ``__getitem__`` (``CNN/dataset.py:107``, SURVEY.md §3.5).
+Here datasets are plain NumPy on the host; batching + a single sharded
+``device_put`` per step happen in :mod:`..data.loader`.
+
+Each reference dataset has a synthetic twin with identical shapes/dtypes so
+every code path runs without the (unavailable) ``/data`` files.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ArrayDataset:
+    """(features, targets) arrays with uniform leading dimension."""
+
+    def __init__(self, features: np.ndarray, targets: np.ndarray):
+        if len(features) != len(targets):
+            raise ValueError(f"length mismatch {len(features)} vs {len(targets)}")
+        self.features = features
+        self.targets = targets
+
+    def __len__(self) -> int:
+        return len(self.features)
+
+    def batch(self, indices: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Gather one batched (x, y) pair — the only hot-path data op."""
+        return self.features[indices], self.targets[indices]
+
+
+# ---------------------------------------------------------------------------
+# Synthetic twins of the reference workload datasets
+# ---------------------------------------------------------------------------
+
+def synthetic_mqtt(n: int = 4096, num_features: int = 48, num_classes: int = 5,
+                   seed: int = 0) -> ArrayDataset:
+    """MQTT-IDS shape twin (reference ``MLP/dataset.py:24-37``): float feature
+    rows + one-hot 5-class targets.  A linear signal is planted so training
+    visibly learns."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, num_features)).astype(np.float32)
+    w = rng.normal(size=(num_features, num_classes))
+    labels = np.argmax(x @ w + 0.1 * rng.normal(size=(n, num_classes)), axis=-1)
+    y = np.eye(num_classes, dtype=np.float32)[labels]
+    return ArrayDataset(x, y)
+
+
+def synthetic_pcb(n: int = 512, image_size: int = 64, num_classes: int = 6,
+                  seed: int = 0) -> ArrayDataset:
+    """PCB-defect shape twin (reference ``CNN/dataset.py:71-111``): 64×64 RGB
+    crops (NHWC — the TPU-native layout, vs torch's NCHW) + one-hot targets."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=n)
+    x = rng.normal(size=(n, image_size, image_size, 3)).astype(np.float32)
+    # plant a class-dependent mean so accuracy can rise
+    x += labels[:, None, None, None].astype(np.float32) * 0.1
+    y = np.eye(num_classes, dtype=np.float32)[labels]
+    return ArrayDataset(x, y)
+
+
+def synthetic_pdm(n: int = 4096, history: int = 10, num_features: int = 10,
+                  num_targets: int = 5, seed: int = 0) -> ArrayDataset:
+    """Predictive-maintenance shape twin (reference ``LSTM/dataset.py:24-45``):
+    sliding windows of `history` timesteps × features, 5-dim regression
+    target (the reference trains L1 on raw targets — quirk Q5)."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, history, num_features)).astype(np.float32)
+    w = rng.normal(size=(num_features, num_targets))
+    y = (x.mean(axis=1) @ w).astype(np.float32)
+    return ArrayDataset(x, y)

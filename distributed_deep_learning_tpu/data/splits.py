@@ -1,0 +1,65 @@
+"""Seeded dataset splitting with *correct* index composition.
+
+The reference splits with ``randperm`` into 70/10/20 train/val/test subsets
+(``CNN/main.py:70-74,165-179``) but then wraps the subset samplers in
+``DistributedSampler``, which re-interprets positional indices as dataset
+indices — so under distributed modes the three "splits" collapse into
+overlapping prefixes of the raw dataset (SURVEY.md quirk Q3).  We compose
+indices properly: split first, then let each consumer take a true subset of
+a split — :class:`..loader.DeviceLoader` derives its per-process rows from
+the array sharding itself; :func:`shard_indices` is the host-level utility
+for cases that shard index lists directly (e.g. per-host file reading).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+FRACTIONS = (0.7, 0.1, 0.2)  # reference split (CNN/main.py:70-74)
+
+
+@dataclasses.dataclass(frozen=True)
+class Splits:
+    train: np.ndarray
+    val: np.ndarray
+    test: np.ndarray
+
+    def __iter__(self):
+        return iter((self.train, self.val, self.test))
+
+
+def train_val_test_split(n: int, seed: int = 42,
+                         fractions: tuple[float, float, float] = FRACTIONS) -> Splits:
+    """Permute ``range(n)`` with a seeded RNG and cut 70/10/20.
+
+    RNG divergence from torch's ``randperm(Generator(42))`` is deliberate and
+    documented (SURVEY.md §7 hard-part (c)): the *distribution* of splits is
+    the contract, not torch's bit-exact stream.
+    """
+    if not np.isclose(sum(fractions), 1.0):
+        raise ValueError(f"fractions must sum to 1, got {fractions}")
+    perm = np.random.default_rng(seed).permutation(n)
+    n_train = int(n * fractions[0])
+    n_val = int(n * fractions[1])
+    return Splits(
+        train=perm[:n_train],
+        val=perm[n_train:n_train + n_val],
+        test=perm[n_train + n_val:],
+    )
+
+
+def shard_indices(indices: np.ndarray, num_shards: int, shard: int,
+                  drop_remainder: bool = True) -> np.ndarray:
+    """Disjoint per-rank shard of a split (replaces DistributedSampler).
+
+    With ``drop_remainder`` every shard gets the same length (collective-
+    friendly: all ranks run the same number of steps).
+    """
+    if not 0 <= shard < num_shards:
+        raise ValueError(f"shard {shard} out of range for {num_shards}")
+    if drop_remainder:
+        per = len(indices) // num_shards
+        return indices[shard * per:(shard + 1) * per]
+    return indices[shard::num_shards]

@@ -1,0 +1,1 @@
+from distributed_deep_learning_tpu.models.mlp import MLP  # noqa: F401

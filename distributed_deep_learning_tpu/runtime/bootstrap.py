@@ -1,0 +1,79 @@
+"""Multi-host bootstrap: `jax.distributed` in place of MPI env sniffing.
+
+The reference detects a distributed launch by scanning the environment for
+``MPI_`` variables and reading ``OMPI_COMM_WORLD_*`` (``CNN/main.py:62-67``),
+then calls ``torch.distributed.init_process_group`` with a backend chosen
+from a hard-coded matrix — including a hard-coded head node
+(``rtx2080-1.mit``) and NIC (``enp3s0``) at ``CNN/main.py:192-193``.
+
+Here a single call covers every topology: on multi-host TPU pods,
+``jax.distributed.initialize()`` picks coordinator/process-id from the TPU
+runtime automatically; for MPI/SLURM launches we forward what
+:class:`DistributedEnv` discovered.  Nothing is hard-coded; everything comes
+from flags or the environment.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from distributed_deep_learning_tpu.utils.config import Config, DistributedEnv
+
+_INITIALIZED = False
+
+
+def initialize_runtime(config: Config | None = None) -> DistributedEnv:
+    """Idempotently initialise the distributed JAX runtime.
+
+    Returns the effective process topology.  Safe to call in single-process
+    runs (no-op).  Must run before the first device access on multi-host.
+    """
+    global _INITIALIZED
+    dist = config.distributed if config is not None else DistributedEnv.from_environ()
+    # Only latch once jax.distributed has actually been initialised — an
+    # early single-process call must not turn a later multi-host call into
+    # a silent no-op.
+    if _INITIALIZED or not dist.is_distributed:
+        return _effective_env(dist)
+
+    kwargs = {}
+    if dist.coordinator:
+        kwargs = dict(
+            coordinator_address=dist.coordinator,
+            num_processes=dist.num_processes,
+            process_id=dist.process_id,
+        )
+    # else: TPU pod — jax.distributed.initialize() autodetects everything.
+    jax.distributed.initialize(**kwargs)
+    _INITIALIZED = True
+    return _effective_env(dist)
+
+
+def _effective_env(dist: DistributedEnv) -> DistributedEnv:
+    return DistributedEnv(
+        process_id=jax.process_index(),
+        num_processes=jax.process_count(),
+        local_process_id=dist.local_process_id,
+        coordinator=dist.coordinator,
+    )
+
+
+def is_coordinator() -> bool:
+    """Rank-0 gate for logging (reference: ``verbose=rank==0``)."""
+    return jax.process_index() == 0
+
+
+def force_host_device_count(n: int) -> None:
+    """Test helper: emulate an `n`-device host platform (the JAX analogue of
+    the reference's fake CPU device list, ``LSTM/model.py:183``).
+
+    Must be called before JAX initialises its backends — typically from a
+    pytest conftest.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    flag = f"--xla_force_host_platform_device_count={n}"
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")

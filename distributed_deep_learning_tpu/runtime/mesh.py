@@ -1,0 +1,133 @@
+"""Device-mesh construction: the TPU-native replacement for process groups.
+
+The reference builds its topology out of `torch.distributed` process groups
+with a 3-backend matrix (NCCL/Gloo/MPI, ``CNN/main.py:186-204``) plus
+per-mode device lists (``CNN/main.py:143-154``).  On TPU the whole matrix
+collapses into one object: a named :class:`jax.sharding.Mesh`.  Parallelism
+modes are just different mesh shapes / sharding rules:
+
+=============  =================================================
+mode           mesh
+=============  =================================================
+sequential     1 device, trivial mesh
+data           ``{"data": N}`` — batch sharded, params replicated
+model          ``{"stage": S}`` — layer stages over devices
+pipeline       ``{"stage": S}`` + microbatch schedule
+hybrid         any combination, e.g. ``{"data": 4, "stage": 2}``
+=============  =================================================
+
+The canonical axis order is ``(data, fsdp, stage, model, seq, expert)``; axes
+of size 1 are kept in the mesh so sharding rules never need to special-case
+which axes exist.  XLA routes collectives over ICI within a slice and DCN
+across slices based on device order, so we keep devices in their default
+(topology-sorted) order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order.  `data` outermost (DCN-friendly: gradient all-reduce
+# tolerates lower bandwidth), then fsdp (ZeRO-style param shard), then stage
+# (pipeline), then model (tensor), then seq (context/ring-attention), then
+# expert (MoE).  Order matters: ICI neighbours should serve the
+# bandwidth-hungry inner axes.
+AXES = ("data", "fsdp", "stage", "model", "seq", "expert")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape over the canonical axes.
+
+    Unspecified axes get size 1.  At most one axis may be -1 ("fill with all
+    remaining devices").
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    stage: int = 1
+    model: int = 1
+    seq: int = 1
+    expert: int = 1
+
+    @staticmethod
+    def from_dict(shape: dict[str, int]) -> "MeshSpec":
+        unknown = set(shape) - set(AXES)
+        if unknown:
+            raise ValueError(f"unknown mesh axes {sorted(unknown)}; known: {AXES}")
+        kw = {a: 1 for a in AXES}
+        kw.update(shape)
+        return MeshSpec(**kw)
+
+    def sizes(self) -> tuple[int, ...]:
+        return tuple(getattr(self, a) for a in AXES)
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        """Replace a single -1 with whatever devices remain."""
+        sizes = list(self.sizes())
+        fills = [i for i, s in enumerate(sizes) if s == -1]
+        if len(fills) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = int(np.prod([s for s in sizes if s != -1]))
+        if fills:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}")
+            sizes[fills[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {dict(zip(AXES, sizes))} wants {fixed} devices, "
+                f"have {n_devices}")
+        return MeshSpec(**dict(zip(AXES, sizes)))
+
+
+def build_mesh(spec: MeshSpec | dict[str, int] | None = None,
+               devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Build a named Mesh over `devices` (default: all of them)."""
+    if devices is None:
+        devices = jax.devices()
+    if spec is None:
+        spec = MeshSpec()
+    if isinstance(spec, dict):
+        spec = MeshSpec.from_dict(spec)
+    spec = spec.resolve(len(devices))
+    arr = np.asarray(devices).reshape(spec.sizes())
+    return Mesh(arr, AXES)
+
+
+def mesh_for_mode(mode: "str | None", n_stages: int | None = None,
+                  devices: Sequence[jax.Device] | None = None,
+                  explicit: dict[str, int] | None = None) -> Mesh:
+    """Pick a mesh shape for a reference execution mode.
+
+    Mirrors the reference's per-mode device-list construction
+    (``CNN/main.py:143-154``) but as mesh shapes.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if explicit:
+        return build_mesh(MeshSpec.from_dict(explicit), devices)
+    mode = str(mode) if mode is not None else "sequential"
+    if mode in ("model", "pipeline"):
+        stages = n_stages or n
+        if n % stages:
+            raise ValueError(f"{n} devices not divisible into {stages} stages")
+        return build_mesh({"stage": stages, "data": n // stages}, devices)
+    if mode == "data":
+        return build_mesh({"data": n}, devices)
+    # sequential: single-device mesh (trivial shardings compile away)
+    return build_mesh({"data": 1}, devices[:1])
+
+
+def local_batch_size(global_batch: int, mesh: Mesh) -> int:
+    n = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+    if global_batch % n:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"data-parallel size {n}")
+    return global_batch // n

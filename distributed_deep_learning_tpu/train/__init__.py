@@ -1,0 +1,6 @@
+from distributed_deep_learning_tpu.train.state import TrainState  # noqa: F401
+from distributed_deep_learning_tpu.train.objectives import (  # noqa: F401
+    cross_entropy_loss, l1_loss, argmax_correct,
+)
+from distributed_deep_learning_tpu.train.step import make_step_fns  # noqa: F401
+from distributed_deep_learning_tpu.train.loop import fit, EpochResult  # noqa: F401

@@ -1,0 +1,96 @@
+"""Epoch loop with the reference's phase/metric semantics.
+
+Reproduces the reference ``worker`` (``CNN/main.py:76-127``): per epoch a
+train phase, a validation phase, LR decay (baked into the optax schedule),
+and one final test phase; accuracy = argmax-match × 100 / samples; the
+logged loss keeps the reference's Σ(batch-mean)/Σ(samples) formula (quirk
+Q9) for log parity.
+
+Unlike the reference (``loss.item()`` per batch forces a device sync every
+step), metric scalars stay on device during the epoch and are fetched once
+at phase end — dispatch stays fully async.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from distributed_deep_learning_tpu.train.state import TrainState
+from distributed_deep_learning_tpu.utils.logging import PhaseLogger
+
+
+@dataclasses.dataclass
+class EpochResult:
+    phase: str
+    epoch: int | None
+    accuracy: float
+    loss: float
+    seconds: float
+    examples: int
+
+    @property
+    def examples_per_sec(self) -> float:
+        return self.examples / self.seconds if self.seconds > 0 else 0.0
+
+
+def _run_phase(step_fn, state, loader, *, train: bool):
+    """Drive one phase; returns (state, totals) with one host sync at end."""
+    device_metrics = []
+    for x, y in loader:
+        if train:
+            state, m = step_fn(state, x, y)
+        else:
+            m = step_fn(state, x, y)
+        device_metrics.append(m)
+    if not device_metrics:
+        return state, {"loss": 0.0, "correct": 0, "count": 0}
+    summed = jax.tree.map(lambda *xs: np.sum(jax.device_get(list(xs)), axis=0),
+                          *device_metrics)
+    return state, summed
+
+
+def _result(phase: str, epoch: int | None, totals, t0: float, t1: float) -> EpochResult:
+    counter = int(totals["count"]) or 1
+    return EpochResult(
+        phase=phase, epoch=epoch,
+        # reference formulas (CNN/main.py:94-95): acc×100/samples,
+        # Σ(batch-mean loss)/samples (Q9)
+        accuracy=float(totals["correct"]) * 100.0 / counter,
+        loss=float(totals["loss"]) / counter,
+        seconds=t1 - t0, examples=int(totals["count"]),
+    )
+
+
+def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
+        test_loader, epochs: int, logger: PhaseLogger | None = None
+        ) -> tuple[TrainState, list[EpochResult]]:
+    logger = logger or PhaseLogger(verbose=False)
+    history: list[EpochResult] = []
+
+    for epoch in range(1, epochs + 1):  # reference counts epochs from 1
+        train_loader.set_epoch(epoch)
+        t0 = logger.phase_begin("train", epoch)
+        state, totals = _run_phase(train_step, state, train_loader, train=True)
+        t1 = logger.clock()
+        res = _result("train", epoch, totals, t0, t1)
+        logger.phase_end("train", epoch, accuracy=res.accuracy, loss=res.loss)
+        history.append(res)
+
+        t0 = logger.clock()
+        _, totals = _run_phase(eval_step, state, val_loader, train=False)
+        t1 = logger.clock()
+        res = _result("validation", epoch, totals, t0, t1)
+        # reference prints only the validation end line (CNN/main.py:111)
+        logger.phase_end("validation", epoch, accuracy=res.accuracy, loss=res.loss)
+        history.append(res)
+
+    t0 = logger.clock()
+    _, totals = _run_phase(eval_step, state, test_loader, train=False)
+    t1 = logger.clock()
+    res = _result("test", None, totals, t0, t1)
+    logger.phase_end("test", accuracy=res.accuracy, loss=res.loss)
+    history.append(res)
+    return state, history

@@ -1,0 +1,60 @@
+"""Training state as a pure pytree (params + optimizer state + step).
+
+The reference mutates an ``nn.Module`` + ``torch.optim`` in place; here state
+is an immutable pytree threaded through a jitted step, which is what lets
+XLA donate buffers, shard optimizer state (ZeRO-1 via a sharding rule on
+``opt_state``), and checkpoint the whole thing with orbax in one call.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    opt_state: optax.OptState
+    apply_fn: Callable = flax.struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+
+    @classmethod
+    def create(cls, *, apply_fn: Callable, params: Any,
+               tx: optax.GradientTransformation) -> "TrainState":
+        import jax.numpy as jnp
+        return cls(step=jnp.zeros((), jnp.int32), params=params,
+                   opt_state=tx.init(params), apply_fn=apply_fn, tx=tx)
+
+    def apply_gradients(self, grads: Any) -> "TrainState":
+        updates, opt_state = self.tx.update(grads, self.opt_state, self.params)
+        params = optax.apply_updates(self.params, updates)
+        return self.replace(step=self.step + 1, params=params,
+                            opt_state=opt_state)
+
+
+def reference_optimizer(workload: str, learning_rate: float | None = None,
+                        epoch_steps: int | None = None) -> optax.GradientTransformation:
+    """The reference's optimizer/schedule per workload:
+
+    * CNN:  SGD(lr=0.01, momentum=0.9) + StepLR(step_size=7 epochs, gamma=0.1)
+      (``CNN/main.py:160-161``; decay stepped once per epoch at ``:112``)
+    * LSTM: Adam(defaults), no decay (``LSTM/main.py:164``)
+    * MLP:  Adam(defaults) (``MLP/main.py:66``)
+    """
+    workload = workload.lower()
+    if workload == "cnn":
+        lr = 0.01 if learning_rate is None else learning_rate
+        if epoch_steps:
+            sched = optax.exponential_decay(
+                lr, transition_steps=7 * epoch_steps, decay_rate=0.1,
+                staircase=True)
+        else:
+            sched = lr
+        return optax.sgd(sched, momentum=0.9)
+    lr = 1e-3 if learning_rate is None else learning_rate
+    return optax.adam(lr)

@@ -1,0 +1,2 @@
+from distributed_deep_learning_tpu.utils.config import Config, Mode, parse_args  # noqa: F401
+from distributed_deep_learning_tpu.utils.logging import PhaseLogger  # noqa: F401

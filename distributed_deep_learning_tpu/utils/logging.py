@@ -1,0 +1,62 @@
+"""Timestamped phase logging, format-compatible with the reference.
+
+The reference's only observability is quote-delimited, UTC-timestamped phase
+lines printed on rank 0 (``CNN/main.py:80,96,111,127``; ``verbose=rank==0``
+at ``:181``), e.g.::
+
+    "train epoch 3 begins at 1714056912.123456"
+    "train epoch 3 ends at 1714056999.456 with accuracy 87.250 and loss 0.013digits"
+
+We reproduce that exact stream (so downstream log scrapers keep working) and
+add structured counters (steps/sec, examples/sec) the reference lacked.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import TextIO
+
+
+class PhaseLogger:
+    """Rank-0-gated phase logger emitting the reference's log grammar."""
+
+    def __init__(self, verbose: bool = True, stream: TextIO | None = None,
+                 clock=time.time):
+        self.verbose = verbose
+        self.stream = stream if stream is not None else sys.stdout
+        self.clock = clock
+
+    def _emit(self, line: str) -> None:
+        if self.verbose:
+            # Reference prints quote-delimited lines for downstream scraping.
+            print(f'"{line}"', file=self.stream, flush=True)
+
+    # -- the reference grammar (CNN/main.py:80,96,111,127) -----------------
+    def phase_begin(self, phase: str, epoch: int | None = None) -> float:
+        t = self.clock()
+        if epoch is None:
+            self._emit(f"{phase} begins at {t:f}")
+        else:
+            self._emit(f"{phase} epoch {epoch} begins at {t:f}")
+        return t
+
+    def phase_end(self, phase: str, epoch: int | None = None, *,
+                  accuracy: float | None = None, loss: float | None = None) -> float:
+        t = self.clock()
+        suffix = ""
+        if accuracy is not None and loss is not None:
+            suffix = f" with accuracy {accuracy:0.3f} and loss {loss:0.9f}"
+        if epoch is None:
+            self._emit(f"{phase} ends at {t:f}{suffix}")
+        else:
+            self._emit(f"{phase} epoch {epoch} ends at {t:f}{suffix}")
+        return t
+
+    # -- framework extensions ----------------------------------------------
+    def metrics(self, **kv) -> None:
+        parts = " ".join(f"{k}={v}" for k, v in kv.items())
+        self._emit(f"metrics {parts}")
+
+    def info(self, msg: str) -> None:
+        self._emit(msg)

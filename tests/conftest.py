@@ -1,0 +1,38 @@
+"""Test env: emulate an 8-device host platform before JAX initialises.
+
+The JAX analogue of the reference's fake CPU device-list trick
+(``LSTM/model.py:183`` builds a model over ``devices=[cpu]*4``): with
+``--xla_force_host_platform_device_count=8`` every pjit/shard_map/collective
+path runs for real on one machine (SURVEY.md §4).
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the outer env may pin a TPU platform
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# A site-installed TPU plugin may override the platform via jax.config at
+# interpreter startup; force it back to CPU before any backend initialises.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from distributed_deep_learning_tpu.runtime.mesh import build_mesh
+    return build_mesh({"data": 8})
+
+
+@pytest.fixture(scope="session")
+def mesh_4x2():
+    from distributed_deep_learning_tpu.runtime.mesh import build_mesh
+    return build_mesh({"data": 4, "stage": 2})

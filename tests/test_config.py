@@ -1,0 +1,67 @@
+from distributed_deep_learning_tpu.utils.config import (
+    Config, DistributedEnv, Mode, parse_args, parse_mesh_arg,
+)
+
+
+def test_reference_flags_parse():
+    cfg = parse_args(["-l", "3", "-s", "64", "-e", "2", "-b", "128",
+                      "-d", "cpu", "-w", "2", "-m", "pipeline", "-p", "16",
+                      "-r", "4"], env={})
+    assert cfg.num_layers == 3
+    assert cfg.size == 64
+    assert cfg.epochs == 2
+    assert cfg.batch_size == 128
+    assert cfg.device.value == "cpu"
+    assert cfg.num_workers == 2
+    assert cfg.mode is Mode.PIPELINE
+    assert cfg.microbatch == 16
+    assert cfg.world_size == 4
+
+
+def test_defaults_match_reference():
+    cfg = parse_args([], env={})
+    assert cfg.mode is Mode.SEQUENTIAL
+    assert cfg.seed == 42  # reference pins manual_seed(42)
+    # reference getConfiguration defaults (CNN/main.py:51-57)
+    assert cfg.epochs == 10
+    assert cfg.batch_size == 32
+    assert cfg.microbatch == 2
+    assert cfg.world_size == 1
+    assert not cfg.distributed.is_distributed
+
+
+def test_workload_defaults():
+    assert parse_args([], workload="cnn", env={}).num_layers == 2
+    assert parse_args([], workload="cnn", env={}).size == 4
+    assert parse_args([], workload="lstm", env={}).size == 128
+    assert parse_args([], workload="mlp", env={}).size == 38
+
+
+def test_mpi_env_detection():
+    env = {"OMPI_COMM_WORLD_RANK": "3", "OMPI_COMM_WORLD_SIZE": "8",
+           "OMPI_COMM_WORLD_LOCAL_RANK": "1", "MASTER_ADDR": "head-node"}
+    dist = DistributedEnv.from_environ(env)
+    assert dist.process_id == 3
+    assert dist.num_processes == 8
+    assert dist.local_process_id == 1
+    assert dist.coordinator == "head-node:29500"
+    assert dist.is_distributed
+
+
+def test_explicit_env_beats_mpi():
+    env = {"DDL_NUM_PROCESSES": "2", "DDL_PROCESS_ID": "1",
+           "OMPI_COMM_WORLD_SIZE": "8", "OMPI_COMM_WORLD_RANK": "5"}
+    dist = DistributedEnv.from_environ(env)
+    assert dist.num_processes == 2
+    assert dist.process_id == 1
+
+
+def test_mesh_arg():
+    assert parse_mesh_arg("data=4,stage=2") == {"data": 4, "stage": 2}
+    assert parse_mesh_arg(None) is None
+
+
+def test_config_immutable_replace():
+    cfg = Config()
+    cfg2 = cfg.replace(epochs=9)
+    assert cfg.epochs != 9 and cfg2.epochs == 9

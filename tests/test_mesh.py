@@ -1,0 +1,53 @@
+import jax
+import pytest
+
+from distributed_deep_learning_tpu.runtime.mesh import (
+    AXES, MeshSpec, build_mesh, local_batch_size, mesh_for_mode,
+)
+
+
+def test_eight_cpu_devices_forced():
+    assert len(jax.devices()) == 8
+
+
+def test_build_default_mesh_fills_data():
+    mesh = build_mesh()
+    assert mesh.shape["data"] == 8
+    assert all(mesh.shape[a] == 1 for a in AXES if a != "data")
+
+
+def test_build_2d_mesh():
+    mesh = build_mesh({"data": 4, "stage": 2})
+    assert mesh.shape["data"] == 4
+    assert mesh.shape["stage"] == 2
+
+
+def test_fill_axis():
+    spec = MeshSpec.from_dict({"stage": 2, "data": -1})
+    mesh = build_mesh(spec)
+    assert mesh.shape["data"] == 4
+
+
+def test_bad_shapes_raise():
+    with pytest.raises(ValueError):
+        build_mesh({"data": 3})  # 8 % 3 != 0
+    with pytest.raises(ValueError):
+        MeshSpec.from_dict({"bogus": 2})
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, stage=-1).resolve(8)
+
+
+def test_mesh_for_modes():
+    assert mesh_for_mode("sequential").devices.size == 1
+    assert mesh_for_mode("data").shape["data"] == 8
+    m = mesh_for_mode("pipeline", n_stages=2)
+    assert m.shape["stage"] == 2 and m.shape["data"] == 4
+    m = mesh_for_mode(None, explicit={"data": 2, "model": 4})
+    assert m.shape["model"] == 4
+
+
+def test_local_batch_size():
+    mesh = build_mesh({"data": 4, "stage": 2})
+    assert local_batch_size(64, mesh) == 16
+    with pytest.raises(ValueError):
+        local_batch_size(30, mesh)
