@@ -9,9 +9,11 @@ classes=5.  Differences by design:
 * the model emits **logits**; the softmax lives in the loss. The reference
   feeds Softmax output into CrossEntropyLoss (quirk Q4) — set
   ``double_softmax=True`` for bit-faithful replication of that behaviour.
-* the layer list is exposed via :meth:`layer_sequence` so the model-parallel
-  partitioners (:mod:`..parallel.partition`) can stage it exactly like the
-  reference's constructor-time partitioning (``MLP/model.py:41-45``).
+* the layer list is exposed via :func:`mlp_layer_sequence` so the
+  model-parallel partitioners (:mod:`..parallel.partition`) can stage it
+  exactly like the reference's constructor-time partitioning
+  (``MLP/model.py:41-45``); :class:`MLP` itself is built from that same
+  sequence, so the sequential and staged paths cannot drift.
 """
 
 from __future__ import annotations
@@ -30,16 +32,14 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         x = x.astype(self.dtype)
-        x = nn.Dense(self.hidden_size, dtype=self.dtype, name="in_proj")(x)
-        x = nn.relu(x)
-        for i in range(self.num_hidden_layers):
-            x = nn.Dense(self.hidden_size, dtype=self.dtype, name=f"hidden_{i}")(x)
-            x = nn.relu(x)
-        x = nn.Dense(self.num_classes, dtype=self.dtype, name="out_proj")(x)
-        if self.double_softmax:
-            # reference quirk Q4: Softmax output fed to a softmax-based loss
-            x = nn.sigmoid(x) if self.num_classes < 2 else nn.softmax(x)
-        return x.astype(jnp.float32)
+        # single source of truth: the same layer sequence the staged
+        # (model/pipeline-parallel) path partitions
+        for layer in mlp_layer_sequence(self.hidden_size,
+                                        self.num_hidden_layers,
+                                        self.num_classes,
+                                        self.double_softmax, self.dtype):
+            x = layer(x)
+        return x
 
     # --- stage partitioning support (model/pipeline modes) -----------------
     @property
@@ -47,3 +47,47 @@ class MLP(nn.Module):
         """Layer count as the reference counts it: in + hidden + out
         (``MLP/model.py:62-76`` partitions ``hidden_layers + 2`` layers)."""
         return self.num_hidden_layers + 2
+
+
+def mlp_layer_sequence(hidden_size: int = 38, num_hidden_layers: int = 1,
+                       num_classes: int = 5, double_softmax: bool = False,
+                       dtype: jnp.dtype = jnp.float32) -> list[nn.Module]:
+    """The MLP as a partitionable layer list (same layer counting as the
+    reference partitioner: in + hidden + out), for
+    :class:`..parallel.staging.StagedModel`.
+
+    A free function (not an ``MLP`` method): Flax wraps module methods in
+    binding machinery that forbids creating child modules outside
+    ``setup``/``compact``.
+    """
+    layers: list[nn.Module] = [DenseReLU(hidden_size, dtype=dtype)]
+    layers += [DenseReLU(hidden_size, dtype=dtype)
+               for _ in range(num_hidden_layers)]
+    layers.append(DenseHead(num_classes, double_softmax=double_softmax,
+                            dtype=dtype))
+    return layers
+
+
+class DenseReLU(nn.Module):
+    """Dense + ReLU as one partitionable layer (reference pairs each Linear
+    with its activation when partitioning)."""
+
+    features: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.relu(nn.Dense(self.features, dtype=self.dtype)(x))
+
+
+class DenseHead(nn.Module):
+    features: int
+    double_softmax: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(self.features, dtype=self.dtype)(x)
+        if self.double_softmax:
+            x = nn.sigmoid(x) if self.features < 2 else nn.softmax(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,9 @@
+from distributed_deep_learning_tpu.parallel.partition import (  # noqa: F401
+    balanced_partition, block_partition, lstm_aware_partition, stage_slices,
+    validate_assignment,
+)
+from distributed_deep_learning_tpu.parallel.staging import Stage, StagedModel  # noqa: F401
+from distributed_deep_learning_tpu.parallel.mpmd import MPMDPipeline  # noqa: F401
+from distributed_deep_learning_tpu.parallel.spmd_pipeline import (  # noqa: F401
+    spmd_pipeline,
+)

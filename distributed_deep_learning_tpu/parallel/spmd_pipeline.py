@@ -1,0 +1,117 @@
+"""SPMD pipeline parallelism: GPipe fill-drain inside one XLA program.
+
+This is the TPU-native pipeline the reference's hand-rolled Python scheduler
+(``MLP/model.py:81-130`` and byte-identical copies) maps onto: all stages
+run the *same* compiled program over a ``stage`` mesh axis (`shard_map`),
+stage parameters are stacked along a leading axis and sharded so each device
+holds its own stage's weights, and activations rotate between neighbouring
+devices with ``lax.ppermute`` over ICI inside a ``lax.scan`` over schedule
+ticks.  Forward AND backward pipeline (the scan/ppermute transpose replays
+the schedule in reverse) — unlike the reference, whose scheduler only
+overlapped forward (SURVEY.md §3.3).
+
+Constraint (inherent to SPMD pipelining): all stages share one
+``stage_fn(params, x) -> y`` with ``y.shape == x.shape`` — i.e. a
+homogeneous stack (transformer blocks, LSTM layers, residual trunks).
+Heterogeneous models use :class:`..mpmd.MPMDPipeline` instead; the usual
+composition for real models is embed (outside) → homogeneous trunk
+(this pipeline) → head (outside).
+
+Schedule: ``T = M + S - 1`` ticks for M microbatches over S stages.  At tick
+``t`` stage ``s`` processes microbatch ``t - s`` (bubble ticks compute on
+garbage and are masked at collection — uniform control flow, nothing
+data-dependent, exactly what XLA wants).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # JAX >= 0.7 exports shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+StageFn = Callable[[Any, jnp.ndarray], jnp.ndarray]
+
+
+def stack_stage_params(params_list: list[Any]) -> Any:
+    """Stack per-stage param pytrees along a new leading `stage` axis.
+
+    Requires homogeneous stages (identical pytree structure and leaf shapes).
+    """
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def spmd_pipeline(stage_fn: StageFn, stacked_params: Any, x: jnp.ndarray, *,
+                  mesh: Mesh, microbatch_size: int | None = None,
+                  axis: str = "stage", batch_axes: tuple[str, ...] = ("data", "fsdp")
+                  ) -> jnp.ndarray:
+    """Run `x` through S pipelined applications of `stage_fn`.
+
+    Args:
+      stage_fn: one stage's computation, shape-preserving.
+      stacked_params: pytree with leading dim S on every leaf, sharded over
+        `axis` (see :func:`stack_stage_params`).
+      x: global batch ``(B, ...)``; also sharded over `batch_axes` if the
+        mesh has data parallelism — pipeline and data parallelism compose
+        inside the same program.
+      microbatch_size: reference ``-p`` semantics (microbatch SIZE); default
+        one microbatch per stage.
+    """
+    S = mesh.shape[axis]
+    B = x.shape[0]
+    if microbatch_size is None:
+        # divisor-safe default: the largest microbatch count <= S that
+        # divides B (M == S when possible, M == 1 in the worst case)
+        M = max(m for m in range(1, S + 1) if B % m == 0)
+        mb = B // M
+    else:
+        mb = microbatch_size
+        if B % mb:
+            raise ValueError(f"batch {B} not divisible by microbatch size {mb}")
+        M = B // mb
+    dp = mesh.shape.get(batch_axes[0], 1) if len(batch_axes) else 1
+    for ax in batch_axes[1:]:
+        dp *= mesh.shape.get(ax, 1)
+    if mb % dp:
+        raise ValueError(
+            f"microbatch size {mb} not divisible by data-parallel size {dp} "
+            f"(mesh axes {batch_axes} = {[mesh.shape.get(a, 1) for a in batch_axes]})")
+    xs = x.reshape(M, mb, *x.shape[1:])
+
+    batch_spec = P(None, batch_axes)  # (M, mb, ...): shard the mb dim
+    param_spec = P(axis)
+
+    @partial(shard_map, mesh=mesh, in_specs=(param_spec, batch_spec),
+             out_specs=batch_spec, check_vma=False)
+    def run(params, xs):
+        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
+        stage = lax.axis_index(axis)
+
+        def tick(carry, t):
+            # stage 0 feeds from the microbatch queue; others from their
+            # left neighbour's previous output (the carry).
+            inp0 = lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, M - 1), keepdims=False)
+            inp = jnp.where(stage == 0, inp0, carry)
+            out = stage_fn(params, inp)
+            nxt = lax.ppermute(out, axis,
+                               [(i, (i + 1) % S) for i in range(S)])
+            return nxt, out
+
+        _, outs = lax.scan(tick, jnp.zeros_like(xs[0]), jnp.arange(M + S - 1))
+        # Microbatch m finishes on the last stage at tick m + S - 1; mask
+        # everyone else and broadcast with a psum (valid rows are unique).
+        res = lax.slice_in_dim(outs, S - 1, S - 1 + M, axis=0)
+        res = jnp.where(stage == S - 1, res, jnp.zeros_like(res))
+        return lax.psum(res, axis)
+
+    out = run(stacked_params, xs)
+    return out.reshape(B, *out.shape[2:])

@@ -1,0 +1,71 @@
+"""Model staging: express a model as partitionable layer stages.
+
+The reference's models subclass ``nn.Sequential`` and their constructors
+split the layer list into per-device ``nn.Sequential`` stages
+(``MLP/model.py:41-45``).  Here staging is separated from modelling: a model
+exposes a *layer sequence* (a list of Flax modules), a partitioner assigns
+layers to stages, and :class:`StagedModel` packages the per-stage submodules
+with shape-threaded initialisation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import numpy as np
+
+from distributed_deep_learning_tpu.parallel.partition import stage_slices
+
+
+class Stage(nn.Module):
+    """A contiguous run of layers executed in order (one pipeline stage)."""
+
+    layers: tuple[nn.Module, ...]
+
+    @nn.compact
+    def __call__(self, x):
+        for layer in self.layers:
+            x = layer(x)
+        return x
+
+
+@dataclasses.dataclass(frozen=True)
+class StagedModel:
+    """A model split into per-stage Flax modules.
+
+    ``params[i]`` inits/applies with ``stages[i]`` only — so each stage's
+    parameters can live on its own device (MPMD) or mesh shard (SPMD).
+    """
+
+    stages: tuple[Stage, ...]
+
+    @staticmethod
+    def from_layers(layers: Sequence[nn.Module], assignment: np.ndarray,
+                    n_stages: int) -> "StagedModel":
+        slices = stage_slices(np.asarray(assignment), n_stages)
+        stages = tuple(Stage(layers=tuple(layers[a:b])) for a, b in slices)
+        return StagedModel(stages=stages)
+
+    def init(self, rng: jax.Array, example: Any) -> list[Any]:
+        """Initialise per-stage params, threading activation shapes through
+        stages with ``eval_shape`` (no real compute on the example)."""
+        import jax.numpy as jnp
+
+        params = []
+        x = example
+        for stage in self.stages:
+            rng, sub = jax.random.split(rng)
+            params.append(stage.init(sub, x))
+            shape = jax.eval_shape(lambda p, v, s=stage: s.apply(p, v),
+                                   params[-1], x)
+            x = jnp.zeros(shape.shape, shape.dtype)
+        return params
+
+    def apply(self, params: Sequence[Any], x: Any) -> Any:
+        """Plain sequential forward (the reference's `sequential` mode)."""
+        for stage, p in zip(self.stages, params):
+            x = stage.apply(p, x)
+        return x

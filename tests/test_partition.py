@@ -1,0 +1,83 @@
+import numpy as np
+import pytest
+
+from distributed_deep_learning_tpu.parallel.partition import (
+    balanced_partition, block_partition, lstm_aware_partition, stage_slices,
+    validate_assignment,
+)
+
+
+def _sizes(a, n_stages):
+    return np.bincount(a, minlength=n_stages)
+
+
+class TestBalanced:
+    def test_even_split(self):
+        a = balanced_partition(8, 4)
+        assert _sizes(a, 4).tolist() == [2, 2, 2, 2]
+
+    def test_remainder_spread(self):
+        for n_layers in range(1, 30):
+            for n_stages in range(1, n_layers + 1):
+                a = balanced_partition(n_layers, n_stages)
+                sizes = _sizes(a, n_stages)
+                assert sizes.max() - sizes.min() <= 1
+                assert sizes.sum() == n_layers
+                validate_assignment(a, n_stages)  # contiguous, starts at 0
+
+    def test_too_few_layers(self):
+        with pytest.raises(ValueError):
+            balanced_partition(2, 3)
+
+
+class TestBlock:
+    def test_reference_operating_point(self):
+        # reference CNN: {i: i//4} for 8 layers on 2 devices (CNN/model.py:200)
+        a = block_partition(8, 2, block_size=4)
+        assert a.tolist() == [0, 0, 0, 0, 1, 1, 1, 1]
+
+    def test_single_stage(self):
+        assert block_partition(9, 1).tolist() == [0] * 9
+
+    def test_clamped(self):
+        a = block_partition(12, 2, block_size=4)
+        assert a.max() == 1 and validate_assignment(a, 2) is not None
+
+
+class TestLSTMAware:
+    def test_identity_when_equal(self):
+        a = lstm_aware_partition(5, 5)
+        assert a.tolist() == [0, 1, 2, 3, 4]
+
+    def test_structure_contract(self):
+        # [stem, pool, lstm*4, head] over 3 stages
+        a = lstm_aware_partition(7, 3)
+        validate_assignment(a, 3)
+        assert a[0] == 0                     # stem pinned to stage 0
+        assert a[-1] >= a[-2]                # head after last hidden
+        hidden = a[2:-1]
+        sizes = np.bincount(hidden, minlength=3)
+        assert sizes.max() - sizes.min() <= 2  # hidden spread
+        assert a[1] <= hidden[0]             # pooling not after first lstm
+
+    def test_many_shapes_valid(self):
+        for n_layers in range(3, 12):
+            for n_stages in range(1, n_layers + 1):
+                a = lstm_aware_partition(n_layers, n_stages)
+                validate_assignment(a, n_stages)
+
+
+def test_stage_slices():
+    a = np.array([0, 0, 1, 2, 2])
+    assert stage_slices(a, 3) == [(0, 2), (2, 3), (3, 5)]
+    # empty stage allowed
+    assert stage_slices(np.array([0, 0]), 2)[1] == (2, 2)
+
+
+def test_validate_rejects():
+    with pytest.raises(ValueError):
+        validate_assignment(np.array([1, 1]), 2)      # must start at 0
+    with pytest.raises(ValueError):
+        validate_assignment(np.array([0, 2, 1]), 3)   # decreasing
+    with pytest.raises(ValueError):
+        validate_assignment(np.array([0, 3]), 3)      # out of range
