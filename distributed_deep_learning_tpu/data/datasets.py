@@ -62,13 +62,15 @@ def synthetic_pcb(n: int = 512, image_size: int = 64, num_classes: int = 6,
     return ArrayDataset(x, y)
 
 
-def synthetic_pdm(n: int = 4096, history: int = 10, num_features: int = 10,
+def synthetic_pdm(n: int = 4096, history: int = 10, num_features: int = 32,
                   num_targets: int = 5, seed: int = 0) -> ArrayDataset:
     """Predictive-maintenance shape twin (reference ``LSTM/dataset.py:24-45``):
     sliding windows of `history` timesteps × features, 5-dim regression
-    target (the reference trains L1 on raw targets — quirk Q5)."""
+    target (the reference trains L1 on raw targets — quirk Q5).  The real
+    CSV has 32 feature columns (the reference's ``LSTM(32, ...)`` width,
+    ``LSTM/model.py:82``), so that is the default here."""
     rng = np.random.default_rng(seed)
     x = rng.normal(size=(n, history, num_features)).astype(np.float32)
-    w = rng.normal(size=(num_features, num_targets))
+    w = rng.normal(size=(num_features, num_targets)) / np.sqrt(num_features)
     y = (x.mean(axis=1) @ w).astype(np.float32)
     return ArrayDataset(x, y)

@@ -1,0 +1,81 @@
+"""Predictive-maintenance windowed dataset (reference ``LSTM/dataset.py``).
+
+Semantics reproduced exactly (``LSTM/dataset.py:24-45``):
+
+* CSV of ``machines × instances_per_machine`` rows (reference: 100 × 8759),
+  last 5 columns are targets, the rest features;
+* sliding windows of ``history`` rows that never cross a machine boundary:
+  ``idx2pos`` maps the flat index to a window *end* ≥ row ``history-1``
+  within its machine (``:36-39``);
+* the item is ``(rows[pos-history+1 .. pos], targets_of_row[pos-history+1])``
+  — note the target comes from the **first** (oldest) row of the window
+  (``data[0,-5:]``, ``:45``), which we keep as the workload definition.
+
+Unlike the reference (per-item pandas ``.iloc`` + ``.to(device)``), windows
+are gathered for a whole batch at once with a single fancy-index — the
+window tensor never materialises beyond the batch.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+NUM_TARGETS = 5
+
+
+class PdMWindowedDataset:
+    """Batch-gather windowed view over per-machine rows; ArrayDataset-API
+    compatible (``__len__``/``batch``)."""
+
+    def __init__(self, features: np.ndarray, targets: np.ndarray,
+                 history: int = 10, instances_per_machine: int = 8759):
+        if len(features) != len(targets):
+            raise ValueError("features/targets length mismatch")
+        if len(features) % instances_per_machine:
+            raise ValueError(
+                f"{len(features)} rows not divisible by instances_per_machine "
+                f"{instances_per_machine}")
+        self.features = features
+        self.targets = targets
+        self.history = history - 1          # reference keeps history-1
+        self.instances_pm = instances_per_machine
+        self.div = instances_per_machine - self.history
+        self.machines = len(features) // instances_per_machine
+        self._offsets = np.arange(-self.history, 1)  # window row offsets
+
+    def __len__(self) -> int:
+        return self.div * self.machines
+
+    def idx2pos(self, idx: np.ndarray) -> np.ndarray:
+        """Flat index → window-end row, skipping machine boundaries
+        (reference ``LSTM/dataset.py:36-39``)."""
+        idx = np.asarray(idx)
+        machine = idx // self.div
+        base = machine * self.instances_pm + self.history
+        return base + (idx - machine * self.div)
+
+    def batch(self, indices: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        pos = self.idx2pos(np.asarray(indices))
+        rows = pos[:, None] + self._offsets            # (B, history)
+        x = self.features[rows]                        # (B, history, F)
+        y = self.targets[pos - self.history]           # first window row (Q5)
+        return x, y
+
+
+def load_pdm(path: str = "/data/PredictiveMaintenance/dataset.csv",
+             history: int = 10,
+             instances_per_machine: int = 8759) -> PdMWindowedDataset:
+    """Load the real CSV (all-float32, last 5 columns targets)."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{path} not found — use data.datasets.synthetic_pdm for the "
+            "shape-compatible synthetic twin")
+    import pandas as pd
+
+    frame = pd.read_csv(path, low_memory=False, dtype="float32")
+    data = frame.values
+    return PdMWindowedDataset(data[:, :-NUM_TARGETS], data[:, -NUM_TARGETS:],
+                              history=history,
+                              instances_per_machine=instances_per_machine)

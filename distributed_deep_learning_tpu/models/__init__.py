@@ -1,1 +1,7 @@
-from distributed_deep_learning_tpu.models.mlp import MLP  # noqa: F401
+from distributed_deep_learning_tpu.models.mlp import MLP, mlp_layer_sequence  # noqa: F401
+from distributed_deep_learning_tpu.models.densenet import (  # noqa: F401
+    DenseNet, densenet_layer_sequence,
+)
+from distributed_deep_learning_tpu.models.cnn_lstm import (  # noqa: F401
+    CNNLSTM, cnn_lstm_layer_sequence,
+)

@@ -1,0 +1,124 @@
+"""CNN-LSTM predictive-maintenance model (reference ``src/pytorch/LSTM/model.py``).
+
+Reference architecture (``LSTM/model.py:70-96``), faithfully including its
+layout quirk: the input window is ``(batch, history=10, features=32)`` and
+``Conv1d(10, 64, k=1)`` treats the **time axis as channels** — so the conv
+mixes the 10 timesteps into 64 channels *per feature column*, and the LSTM
+then runs over those 64 channels as its sequence axis with the 32 feature
+columns as its input width (that is why the reference declares
+``LSTM(32, hidden)``).  Sequence: ``Conv1d(k=1)+ReLU → MaxPool1d(1)+ReLU``
+(the pool is a no-op and the second ReLU idempotent — kept as a layer for
+partition-count parity) ``→ LSTM(32→H) → [LSTM(H→H)]×(n-1) → final hidden
+state → Linear(H, 5)``.  No softmax: the workload regresses 5 raw targets
+with L1 while logging argmax "accuracy" (quirk Q5).
+
+TPU-native: the LSTM is a ``flax.linen.RNN`` over ``OptimizedLSTMCell`` —
+an XLA ``lax.scan`` with static shapes.  The reference had to *disable*
+``torch.compile`` for this model (``LSTM/main.py:162``) because cuDNN LSTM +
+dynamo choke on it; under XLA the whole scan compiles like everything else.
+
+Layer counting for the partitioners matches the reference (``hidden_layers
++ 3``: conv, pool, each LSTM, head — ``LSTM/model.py:50``), so
+:func:`..parallel.partition.lstm_aware_partition` applies unchanged.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class PdMConvStem(nn.Module):
+    """Conv1d(history→conv_features, k=1) + ReLU over the time-as-channels
+    layout; emits ``(batch, conv_features, features)`` so downstream LSTM
+    layers see channels as their sequence axis (the reference's implicit
+    batch_first interpretation)."""
+
+    conv_features: int = 64
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        del train
+        # x: (B, history, F).  Conv over the F axis with history as channels:
+        # put channels last for flax, convolve, then channels (64) become the
+        # sequence axis.
+        x = x.astype(self.dtype)
+        x = jnp.swapaxes(x, 1, 2)                      # (B, F, history)
+        x = nn.Conv(self.conv_features, (1,), dtype=self.dtype)(x)  # (B, F, C)
+        x = nn.relu(x)
+        return jnp.swapaxes(x, 1, 2)                   # (B, C=seq, F=width)
+
+
+class PoolReLU(nn.Module):
+    """MaxPool1d(kernel=1) + ReLU — a no-op over non-negative inputs, kept
+    as its own layer for partition-count parity (``LSTM/model.py:79-80``)."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        del train
+        return nn.relu(x)
+
+
+class LSTMLayer(nn.Module):
+    """One LSTM layer via ``nn.RNN`` (lax.scan).  ``return_state`` selects
+    the reference's ``ExtractFinalStateFromLSTM`` (final hidden state) vs
+    ``ExtractOutputFromLSTM`` (full sequence) unwrapping."""
+
+    hidden_size: int = 128
+    return_state: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        del train
+        rnn = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size, dtype=self.dtype),
+                     return_carry=self.return_state)
+        if self.return_state:
+            (_, hidden), _ = rnn(x)
+            return hidden          # (B, hidden): final hidden state
+        return rnn(x)              # (B, seq, hidden)
+
+
+class RegressionHead(nn.Module):
+    num_targets: int = 5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        del train
+        return nn.Dense(self.num_targets,
+                        dtype=self.dtype)(x).astype(jnp.float32)
+
+
+def cnn_lstm_layer_sequence(hidden_layers: int = 1, hidden_size: int = 128,
+                            num_targets: int = 5, conv_features: int = 64,
+                            dtype: jnp.dtype = jnp.float32) -> list[nn.Module]:
+    """Partitionable layer list, ``hidden_layers + 3`` entries
+    (``LSTM/model.py:50``)."""
+    if hidden_layers < 1:
+        raise ValueError("model requires at least one hidden layer")
+    layers: list[nn.Module] = [PdMConvStem(conv_features, dtype), PoolReLU()]
+    for i in range(hidden_layers):
+        last = i == hidden_layers - 1
+        layers.append(LSTMLayer(hidden_size, return_state=last, dtype=dtype))
+    layers.append(RegressionHead(num_targets, dtype))
+    return layers
+
+
+class CNNLSTM(nn.Module):
+    """Sequential CNN-LSTM, built from the same staged layer sequence."""
+
+    hidden_layers: int = 1
+    hidden_size: int = 128
+    num_targets: int = 5
+    conv_features: int = 64
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for layer in cnn_lstm_layer_sequence(
+                self.hidden_layers, self.hidden_size, self.num_targets,
+                self.conv_features, self.dtype):
+            x = layer(x, train=train)
+        return x

@@ -1,0 +1,223 @@
+"""DenseNet-BC CNN workload model (reference ``src/pytorch/CNN/model.py``).
+
+Reference architecture (derived from torchvision densenet + a PCB-defect
+paper, ``CNN/model.py:21-24``): stem ``Conv7×7 s2 → BN/ReLU → MaxPool3×3 s2``
+→ ``dense_blocks`` × [DenseBlock(+Transition between blocks)] → ``AvgPool7 →
+Flatten → Linear → Softmax``; growth_rate 32, ``num_init_features = 2×growth``,
+``dense_layers=6`` per block, BN eps 1e-3.  DenseLayer is the BC bottleneck:
+``BN→ReLU→Conv1×1(bn_size·k)→BN→ReLU→Conv3×3(k)``.
+
+TPU-native differences (behaviour-preserving):
+
+* **NHWC layout** (TPU's native conv layout) instead of NCHW.
+* The reference needed a ``WrapperTriton`` module so its list-append feature
+  concat stayed ``torch.compile``-able (``CNN/model.py:72``); in JAX the
+  concat is just a functional ``jnp.concatenate`` — XLA fuses it.
+* torch ``momentum=0.99`` means "new stats ≈ 99% current batch"; Flax's
+  momentum is the complement, so we pass 0.01.
+* The head emits logits by default (quirk Q4 opt-in via ``double_softmax``).
+* ``GlobalPool`` clamps its window to the spatial extent so configs deeper
+  than the reference's 2 blocks still work (torch's ``AvgPool2d(7)`` would
+  raise on a 4×4 map).
+* The reference's constructor has an off-by-one that collocates the last
+  DenseBlock with the preceding Transition stage and leaves one declared
+  layer id empty (``CNN/model.py:176-190``: the loop leaves ``layer_id`` on
+  the Transition, the last block is appended there, then ``layer_id`` is
+  bumped twice).  We use the clean layer sequence; partition counts match
+  the reference's ``nlayers = 3 + 2(B-1)+1 + 2`` formula.
+* BatchNorm under data parallelism: in the default ``jit``+sharding path
+  the batch-mean reduction spans the *global* (sharded) batch, so statistics
+  are globally consistent by construction — a documented improvement over
+  the reference, which keeps unsynced per-replica stats (SURVEY.md §7
+  hard-part (d)).  The ``axis_name`` field only matters inside manual
+  ``shard_map``/``pmap`` regions, where it names the mapped axis for
+  ``pmean``; leave it ``None`` (the default) under ``jit``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+BN_EPS = 1e-3
+BN_MOMENTUM = 0.01  # == torch momentum 0.99 (complement convention)
+conv_init = nn.initializers.he_normal()  # reference: kaiming_normal_
+
+
+def _bn(dtype, axis_name=None, name=None):
+    return nn.BatchNorm(use_running_average=None, epsilon=BN_EPS,
+                        momentum=BN_MOMENTUM, dtype=dtype,
+                        axis_name=axis_name, name=name)
+
+
+class DenseLayer(nn.Module):
+    """BC bottleneck: BN→ReLU→Conv1×1→BN→ReLU→Conv3×3, returns k new maps."""
+
+    growth_rate: int = 32
+    bn_size: int = 4
+    dtype: jnp.dtype = jnp.float32
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = _bn(self.dtype, self.axis_name)(x, use_running_average=not train)
+        y = nn.relu(y)
+        y = nn.Conv(self.bn_size * self.growth_rate, (1, 1), use_bias=False,
+                    kernel_init=conv_init, dtype=self.dtype)(y)
+        y = _bn(self.dtype, self.axis_name)(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = nn.Conv(self.growth_rate, (3, 3), padding=1, use_bias=False,
+                    kernel_init=conv_init, dtype=self.dtype)(y)
+        return y
+
+
+class DenseBlock(nn.Module):
+    """num_layers DenseLayers with cumulative channel concatenation."""
+
+    num_layers: int = 6
+    growth_rate: int = 32
+    bn_size: int = 4
+    dtype: jnp.dtype = jnp.float32
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for _ in range(self.num_layers):
+            y = DenseLayer(self.growth_rate, self.bn_size, self.dtype,
+                           self.axis_name)(x, train=train)
+            x = jnp.concatenate([x, y], axis=-1)
+        return x
+
+
+class Transition(nn.Module):
+    """BN→ReLU→Conv1×1(halve channels)→AvgPool2×2."""
+
+    out_features: int
+    dtype: jnp.dtype = jnp.float32
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = _bn(self.dtype, self.axis_name)(x, use_running_average=not train)
+        x = nn.relu(x)
+        x = nn.Conv(self.out_features, (1, 1), use_bias=False,
+                    kernel_init=conv_init, dtype=self.dtype)(x)
+        return nn.avg_pool(x, (2, 2), strides=(2, 2))
+
+
+class Stem(nn.Module):
+    """Conv7×7 s2 (no BN/ReLU — those are the next reference layer)."""
+
+    num_features: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        del train
+        return nn.Conv(self.num_features, (7, 7), strides=2, padding=3,
+                       use_bias=False, kernel_init=conv_init,
+                       dtype=self.dtype)(x)
+
+
+class StemNorm(nn.Module):
+    dtype: jnp.dtype = jnp.float32
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = _bn(self.dtype, self.axis_name)(x, use_running_average=not train)
+        return nn.relu(x)
+
+
+class StemPool(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        del train
+        # torch MaxPool2d(3, stride=2, padding=1)
+        return nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+
+class GlobalPool(nn.Module):
+    """AvgPool7 + Flatten (reference ``CNN/model.py:181-182``).
+
+    The window is clamped to the incoming spatial extent: at the reference
+    operating point (2 blocks → 8×8 maps) this is exactly AvgPool(7); for
+    deeper configs whose maps shrink below 7×7 (where torch's AvgPool2d(7)
+    would error and a naive jax avg_pool silently returns a size-0 output)
+    it degrades to global average pooling.
+    """
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        del train
+        k = min(7, x.shape[1], x.shape[2])
+        x = nn.avg_pool(x, (k, k), strides=(k, k))
+        return x.reshape(x.shape[0], -1)
+
+
+class Classifier(nn.Module):
+    num_classes: int = 6
+    double_softmax: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        del train
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     bias_init=nn.initializers.zeros)(x)
+        if self.double_softmax:  # reference quirk Q4
+            x = nn.softmax(x)
+        return x.astype(jnp.float32)
+
+
+def densenet_layer_sequence(dense_blocks: int = 2, dense_layers: int = 6,
+                            growth_rate: int = 32, bn_size: int = 4,
+                            num_classes: int = 6, double_softmax: bool = False,
+                            dtype: jnp.dtype = jnp.float32,
+                            axis_name: str | None = None) -> list[nn.Module]:
+    """The partitionable layer list; count matches the reference's
+    ``nlayers = 3 + (2·(dense_blocks-1)+1) + 2`` (``CNN/model.py:137``)."""
+    if dense_blocks < 1:
+        raise ValueError("model requires at least one dense block")
+    num_features = growth_rate * 2
+    layers: list[nn.Module] = [
+        Stem(num_features, dtype),
+        StemNorm(dtype, axis_name),
+        StemPool(),
+    ]
+    for _ in range(dense_blocks - 1):
+        layers.append(DenseBlock(dense_layers, growth_rate, bn_size, dtype,
+                                 axis_name))
+        num_features += dense_layers * growth_rate
+        layers.append(Transition(num_features // 2, dtype, axis_name))
+        num_features //= 2
+    layers.append(DenseBlock(dense_layers, growth_rate, bn_size, dtype,
+                             axis_name))
+    num_features += dense_layers * growth_rate
+    layers.append(GlobalPool())
+    layers.append(Classifier(num_classes, double_softmax, dtype))
+    return layers
+
+
+class DenseNet(nn.Module):
+    """Sequential DenseNet-BC, built from the same staged layer sequence."""
+
+    dense_blocks: int = 2
+    dense_layers: int = 6
+    growth_rate: int = 32
+    bn_size: int = 4
+    num_classes: int = 6
+    double_softmax: bool = False
+    dtype: jnp.dtype = jnp.float32
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for layer in densenet_layer_sequence(
+                self.dense_blocks, self.dense_layers, self.growth_rate,
+                self.bn_size, self.num_classes, self.double_softmax,
+                self.dtype, self.axis_name):
+            x = layer(x, train=train)
+        return x

@@ -30,7 +30,7 @@ class MLP(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         x = x.astype(self.dtype)
         # single source of truth: the same layer sequence the staged
         # (model/pipeline-parallel) path partitions
@@ -38,7 +38,7 @@ class MLP(nn.Module):
                                         self.num_hidden_layers,
                                         self.num_classes,
                                         self.double_softmax, self.dtype):
-            x = layer(x)
+            x = layer(x, train=train)
         return x
 
     # --- stage partitioning support (model/pipeline modes) -----------------
@@ -76,7 +76,8 @@ class DenseReLU(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = False):
+        del train
         return nn.relu(nn.Dense(self.features, dtype=self.dtype)(x))
 
 
@@ -86,7 +87,8 @@ class DenseHead(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = False):
+        del train
         x = nn.Dense(self.features, dtype=self.dtype)(x)
         if self.double_softmax:
             x = nn.sigmoid(x) if self.features < 2 else nn.softmax(x)

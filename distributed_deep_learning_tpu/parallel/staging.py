@@ -21,14 +21,18 @@ from distributed_deep_learning_tpu.parallel.partition import stage_slices
 
 
 class Stage(nn.Module):
-    """A contiguous run of layers executed in order (one pipeline stage)."""
+    """A contiguous run of layers executed in order (one pipeline stage).
+
+    All partitionable layer modules share the ``__call__(x, train=False)``
+    signature (layers without train-time behaviour just ignore it).
+    """
 
     layers: tuple[nn.Module, ...]
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = False):
         for layer in self.layers:
-            x = layer(x)
+            x = layer(x, train=train)
         return x
 
 
@@ -50,22 +54,62 @@ class StagedModel:
         return StagedModel(stages=stages)
 
     def init(self, rng: jax.Array, example: Any) -> list[Any]:
-        """Initialise per-stage params, threading activation shapes through
-        stages with ``eval_shape`` (no real compute on the example)."""
+        """Initialise per-stage variables (params + any batch stats),
+        threading activation shapes through stages with ``eval_shape``."""
         import jax.numpy as jnp
 
-        params = []
+        variables = []
         x = example
         for stage in self.stages:
             rng, sub = jax.random.split(rng)
-            params.append(stage.init(sub, x))
-            shape = jax.eval_shape(lambda p, v, s=stage: s.apply(p, v),
-                                   params[-1], x)
+            variables.append(stage.init(sub, x))
+            shape = jax.eval_shape(lambda v, a, s=stage: s.apply(v, a),
+                                   variables[-1], x)
             x = jnp.zeros(shape.shape, shape.dtype)
-        return params
+        return variables
 
-    def apply(self, params: Sequence[Any], x: Any) -> Any:
+    def apply(self, variables: Sequence[Any], x: Any) -> Any:
         """Plain sequential forward (the reference's `sequential` mode)."""
-        for stage, p in zip(self.stages, params):
-            x = stage.apply(p, x)
+        for stage, v in zip(self.stages, variables):
+            x = stage.apply(v, x)
         return x
+
+    def split_variables(self, flat_variables: Any) -> list[Any]:
+        """Re-key a *sequential* (single-stage) variable dict into this
+        staging's per-stage variable dicts.
+
+        A ``Stage`` names its children ``layers_0..layers_{k-1}`` locally;
+        the flat form names them ``layers_0..layers_{L-1}`` globally.  This
+        maps global → local by each stage's slice offset, enabling
+        cross-mode interop (e.g. load a sequential checkpoint into a
+        model/pipeline-parallel run).
+        """
+        sizes = [len(s.layers) for s in self.stages]
+        out: list[Any] = []
+        start = 0
+        for size in sizes:
+            stage_vars: dict[str, dict] = {}
+            for coll, entries in flat_variables.items():
+                stage_vars[coll] = {
+                    f"layers_{i}": entries[f"layers_{start + i}"]
+                    for i in range(size)
+                    if f"layers_{start + i}" in entries
+                }
+            out.append(stage_vars)
+            start += size
+        return out
+
+    def apply_train(self, variables: Sequence[Any], x: Any
+                    ) -> tuple[Any, list[Any]]:
+        """Train-mode forward: returns output + per-stage variables with any
+        mutable collections (BatchNorm stats) advanced."""
+        new_vars = []
+        for stage, v in zip(self.stages, variables):
+            mutable = [k for k in v if k != "params"]
+            if mutable:
+                x, upd = stage.apply(v, x, train=True, mutable=mutable)
+                new_vars.append({**v, **upd})
+            else:
+                x = stage.apply(v, x, train=True)
+                new_vars.append(v)
+        return x, new_vars

@@ -1,4 +1,6 @@
-from distributed_deep_learning_tpu.train.state import TrainState  # noqa: F401
+from distributed_deep_learning_tpu.train.state import (  # noqa: F401
+    TrainState, create_train_state, reference_optimizer,
+)
 from distributed_deep_learning_tpu.train.objectives import (  # noqa: F401
     cross_entropy_loss, l1_loss, argmax_correct,
 )

@@ -17,24 +17,53 @@ import optax
 
 @flax.struct.dataclass
 class TrainState:
+    """``apply_fn(params, model_state, x, train) -> (pred, new_model_state)``
+    — the uniform calling convention all step builders use.  ``model_state``
+    carries non-trained variable collections (BatchNorm running stats);
+    models without any use ``{}``."""
+
     step: jax.Array
     params: Any
+    model_state: Any
     opt_state: optax.OptState
     apply_fn: Callable = flax.struct.field(pytree_node=False)
     tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
 
     @classmethod
     def create(cls, *, apply_fn: Callable, params: Any,
-               tx: optax.GradientTransformation) -> "TrainState":
+               tx: optax.GradientTransformation,
+               model_state: Any = None) -> "TrainState":
         import jax.numpy as jnp
         return cls(step=jnp.zeros((), jnp.int32), params=params,
+                   model_state={} if model_state is None else model_state,
                    opt_state=tx.init(params), apply_fn=apply_fn, tx=tx)
 
-    def apply_gradients(self, grads: Any) -> "TrainState":
+    def apply_gradients(self, grads: Any, model_state: Any = None) -> "TrainState":
         updates, opt_state = self.tx.update(grads, self.opt_state, self.params)
         params = optax.apply_updates(self.params, updates)
-        return self.replace(step=self.step + 1, params=params,
-                            opt_state=opt_state)
+        return self.replace(
+            step=self.step + 1, params=params, opt_state=opt_state,
+            model_state=self.model_state if model_state is None else model_state)
+
+
+def create_train_state(model, rng: jax.Array, example: Any,
+                       tx: optax.GradientTransformation) -> TrainState:
+    """Build a TrainState from a Flax module following this package's model
+    convention: ``model(x, train=...)``, mutable collections beyond
+    ``params`` (e.g. ``batch_stats``) advanced in train mode."""
+    variables = dict(model.init(rng, example))
+    params = variables.pop("params")
+    model_state = variables  # batch_stats etc. ({} for stateless models)
+
+    def apply_fn(p, ms, x, train=False):
+        v = {"params": p, **ms}
+        if train and ms:
+            pred, upd = model.apply(v, x, train=True, mutable=list(ms))
+            return pred, {**ms, **upd}
+        return model.apply(v, x, train=train), ms
+
+    return TrainState.create(apply_fn=apply_fn, params=params, tx=tx,
+                             model_state=model_state)
 
 
 def reference_optimizer(workload: str, learning_rate: float | None = None,

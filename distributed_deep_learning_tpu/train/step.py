@@ -39,25 +39,28 @@ def make_step_fns(mesh: Mesh, loss_fn: LossFn, *,
     batch_sh = NamedSharding(mesh, batch_spec)
     repl = NamedSharding(mesh, P())
 
-    def loss_and_metrics(params, apply_fn, x, y):
-        pred = apply_fn(params, x)
-        loss = loss_fn(pred, y)
-        metrics = {
+    def _metrics(pred, y, loss):
+        return {
             "loss": loss,
             "correct": argmax_correct(pred, y).astype(jnp.int32),
-            "count": jnp.asarray(x.shape[0], jnp.int32),
+            "count": jnp.asarray(y.shape[0], jnp.int32),
         }
-        return loss, metrics
 
     def train_step(state: TrainState, x, y):
-        grad_fn = jax.value_and_grad(
-            lambda p: loss_and_metrics(p, state.apply_fn, x, y), has_aux=True)
-        (_, metrics), grads = grad_fn(state.params)
-        return state.apply_gradients(grads), metrics
+        def compute(params):
+            pred, new_ms = state.apply_fn(params, state.model_state, x,
+                                          train=True)
+            loss = loss_fn(pred, y)
+            return loss, (_metrics(pred, y, loss), new_ms)
+
+        grad_fn = jax.value_and_grad(compute, has_aux=True)
+        (_, (metrics, new_ms)), grads = grad_fn(state.params)
+        return state.apply_gradients(grads, model_state=new_ms), metrics
 
     def eval_step(state: TrainState, x, y):
-        _, metrics = loss_and_metrics(state.params, state.apply_fn, x, y)
-        return metrics
+        pred, _ = state.apply_fn(state.params, state.model_state, x,
+                                 train=False)
+        return _metrics(pred, y, loss_fn(pred, y))
 
     train_step = jax.jit(
         train_step,

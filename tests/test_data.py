@@ -38,7 +38,7 @@ def test_synthetic_shapes():
     pcb = synthetic_pcb(8)
     assert pcb.features.shape == (8, 64, 64, 3)
     pdm = synthetic_pdm(16)
-    assert pdm.features.shape == (16, 10, 10) and pdm.targets.shape == (16, 5)
+    assert pdm.features.shape == (16, 10, 32) and pdm.targets.shape == (16, 5)
 
 
 def test_loader_shards_batch_over_mesh(mesh8):

@@ -1,0 +1,209 @@
+"""DenseNet-BC and CNN-LSTM workload models: shapes, staging parity, and
+short end-to-end training on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_deep_learning_tpu.data.datasets import (
+    synthetic_pcb, synthetic_pdm,
+)
+from distributed_deep_learning_tpu.data.loader import DeviceLoader
+from distributed_deep_learning_tpu.models.cnn_lstm import (
+    CNNLSTM, cnn_lstm_layer_sequence,
+)
+from distributed_deep_learning_tpu.models.densenet import (
+    DenseNet, densenet_layer_sequence,
+)
+from distributed_deep_learning_tpu.parallel.partition import (
+    balanced_partition, lstm_aware_partition,
+)
+from distributed_deep_learning_tpu.parallel.staging import StagedModel
+from distributed_deep_learning_tpu.train.objectives import l1_loss
+from distributed_deep_learning_tpu.train.state import (
+    create_train_state, reference_optimizer,
+)
+from distributed_deep_learning_tpu.train.step import make_step_fns, place_state
+
+
+class TestDenseNet:
+    def test_forward_shapes_and_feature_math(self):
+        # reference defaults: growth 32, init 64, 6 layers/block, 2 blocks
+        model = DenseNet(dense_blocks=2, dense_layers=6, bn_size=4)
+        x = jnp.zeros((2, 64, 64, 3))
+        variables = model.init(jax.random.key(0), x)
+        out = model.apply(variables, x)
+        assert out.shape == (2, 6)
+        # final dense features: (64+6*32)/2 + 6*32 = 320 (reference math)
+        kernel = variables["params"]["Classifier_0"]["Dense_0"]["kernel"]
+        assert kernel.shape == (320, 6)
+
+    def test_train_mode_advances_batch_stats(self):
+        model = DenseNet(dense_blocks=1, dense_layers=2)
+        x = jax.random.normal(jax.random.key(1), (4, 64, 64, 3))
+        variables = model.init(jax.random.key(0), x)
+        out, upd = model.apply(variables, x, train=True, mutable=["batch_stats"])
+        before = jax.tree.leaves(variables["batch_stats"])
+        after = jax.tree.leaves(upd["batch_stats"])
+        assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+    def test_layer_sequence_count_matches_reference_formula(self):
+        for blocks in (1, 2, 3):
+            layers = densenet_layer_sequence(dense_blocks=blocks)
+            assert len(layers) == 3 + (2 * (blocks - 1) + 1) + 2
+
+    def test_staged_matches_sequential(self):
+        """Numerical parity: a 2-stage split computes the same function as
+        the 1-stage (sequential) staging of the same layer sequence, with
+        the SAME parameters (re-keyed via split_variables)."""
+        layers = densenet_layer_sequence(dense_blocks=2, dense_layers=2)
+        n = len(layers)
+        seq = StagedModel.from_layers(layers, balanced_partition(n, 1), 1)
+        staged = StagedModel.from_layers(layers, balanced_partition(n, 2), 2)
+
+        flat_vars = seq.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))[0]
+        stage_vars = staged.split_variables(flat_vars)
+
+        x = jax.random.normal(jax.random.key(2), (2, 64, 64, 3))
+        expected = seq.apply([flat_vars], x)
+        got = staged.apply(stage_vars, x)
+        np.testing.assert_allclose(np.asarray(expected), np.asarray(got),
+                                   rtol=1e-5, atol=1e-6)
+
+        # train mode: outputs match too, and batch stats actually advance
+        exp_train, _ = seq.apply_train([flat_vars], x)
+        got_train, new_vars = staged.apply_train(stage_vars, x)
+        np.testing.assert_allclose(np.asarray(exp_train), np.asarray(got_train),
+                                   rtol=1e-5, atol=1e-6)
+        before = jax.tree.leaves([v["batch_stats"] for v in stage_vars])
+        after = jax.tree.leaves([v["batch_stats"] for v in new_vars])
+        assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+    def test_dp_training_learns(self, mesh8):
+        ds = synthetic_pcb(256, seed=7)
+        model = DenseNet(dense_blocks=1, dense_layers=2, num_classes=6)
+        state = create_train_state(model, jax.random.key(0),
+                                   jnp.zeros((1, 64, 64, 3)),
+                                   reference_optimizer("cnn"))
+        state = place_state(state, mesh8)
+        from distributed_deep_learning_tpu.train.objectives import cross_entropy_loss
+        train_step, _ = make_step_fns(mesh8, cross_entropy_loss)
+        loader = DeviceLoader(ds, np.arange(len(ds)), 32, mesh8, shuffle=True)
+        losses = []
+        for epoch in range(3):
+            loader.set_epoch(epoch)
+            for x, y in loader:
+                state, m = train_step(state, x, y)
+                losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0]
+        assert np.isfinite(losses).all()
+
+
+class TestCNNLSTM:
+    def test_forward_shape(self):
+        model = CNNLSTM(hidden_layers=2, hidden_size=64)
+        x = jnp.zeros((3, 10, 32))
+        variables = model.init(jax.random.key(0), x)
+        out = model.apply(variables, x)
+        assert out.shape == (3, 5)
+
+    def test_layer_count_matches_reference(self):
+        for h in (1, 2, 3):
+            assert len(cnn_lstm_layer_sequence(hidden_layers=h)) == h + 3
+
+    def test_staged_with_lstm_aware_partition(self):
+        layers = cnn_lstm_layer_sequence(hidden_layers=3, hidden_size=32)
+        a = lstm_aware_partition(len(layers), 4)
+        staged = StagedModel.from_layers(layers, a, 4)
+        variables = staged.init(jax.random.key(0), jnp.zeros((2, 10, 32)))
+        out = staged.apply(variables, jnp.ones((2, 10, 32)))
+        assert out.shape == (2, 5)
+
+    def test_l1_training_reduces_loss(self, mesh8):
+        ds = synthetic_pdm(512, seed=11)
+        model = CNNLSTM(hidden_layers=1, hidden_size=32)
+        state = create_train_state(model, jax.random.key(0),
+                                   jnp.zeros((1, 10, 32)),
+                                   reference_optimizer("lstm"))
+        state = place_state(state, mesh8)
+        train_step, _ = make_step_fns(mesh8, l1_loss)
+        loader = DeviceLoader(ds, np.arange(len(ds)), 64, mesh8, shuffle=True)
+        losses = []
+        for epoch in range(4):
+            loader.set_epoch(epoch)
+            for x, y in loader:
+                state, m = train_step(state, x, y)
+                losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0] * 0.9
+
+
+def test_pdm_windowing_semantics():
+    from distributed_deep_learning_tpu.data.pdm import PdMWindowedDataset
+
+    ipm, machines, history, nfeat = 50, 3, 10, 4
+    rows = ipm * machines
+    features = np.arange(rows * nfeat, dtype=np.float32).reshape(rows, nfeat)
+    targets = np.tile(np.arange(rows, dtype=np.float32)[:, None], (1, 5))
+    ds = PdMWindowedDataset(features, targets, history=history,
+                            instances_per_machine=ipm)
+    # reference length formula: (ipm - (history-1)) * machines
+    assert len(ds) == (ipm - history + 1) * machines
+    # windows never cross machine boundaries
+    pos = ds.idx2pos(np.arange(len(ds)))
+    assert ((pos % ipm) >= history - 1).all()
+    x, y = ds.batch(np.array([0, len(ds) - 1]))
+    assert x.shape == (2, history, nfeat)
+    # target comes from the FIRST (oldest) row of the window (quirk Q5)
+    np.testing.assert_array_equal(y[0], targets[pos[0] - history + 1])
+
+
+def test_pdm_missing_file_raises():
+    from distributed_deep_learning_tpu.data.pdm import load_pdm
+
+    with pytest.raises(FileNotFoundError):
+        load_pdm("/nonexistent/dataset.csv")
+
+
+def test_pcb_missing_dir_raises():
+    from distributed_deep_learning_tpu.data.pcb import PCBDataset
+
+    with pytest.raises(FileNotFoundError):
+        PCBDataset("/nonexistent/")
+
+
+def test_pcb_parsing_and_crop(tmp_path):
+    """Synthesize a tiny VOC-style tree and check parsing + crop semantics."""
+    import numpy as np
+    from PIL import Image
+
+    from distributed_deep_learning_tpu.data.pcb import PCBDataset
+
+    for cls in ("scratch", "short"):
+        (tmp_path / "Annotations" / cls).mkdir(parents=True)
+        (tmp_path / "images" / cls).mkdir(parents=True)
+        # gradient image so shifted crops actually differ
+        gy, gx = np.meshgrid(np.arange(100), np.arange(120), indexing="ij")
+        img = np.stack([gy * 2 % 256, gx * 2 % 256, (gy + gx) % 256],
+                       axis=-1).astype(np.uint8)
+        Image.fromarray(img).save(tmp_path / "images" / cls / "a.jpg")
+        (tmp_path / "Annotations" / cls / "a.xml").write_text(
+            "<annotation><object><bndbox>"
+            "<xmin>10</xmin><ymin>20</ymin><xmax>40</xmax><ymax>60</ymax>"
+            "</bndbox></object>"
+            "<object><bndbox>"
+            "<xmin>50</xmin><ymin>5</ymin><xmax>80</xmax><ymax>45</ymax>"
+            "</bndbox></object></annotation>")
+
+    ds = PCBDataset(str(tmp_path), seed=0)
+    assert ds.classes == ["scratch", "short"]
+    assert len(ds) == 2 * 4  # 2 images × 2 boxes × 2 augmentation
+    x, y = ds.batch(np.arange(len(ds)))
+    assert x.shape == (8, 64, 64, 3) and y.shape == (8, 2)
+    assert x.dtype == np.float32
+    # augmentation: the two virtual samples of one bbox differ unless the
+    # shifts happened to collide
+    if ds.shift[0] != ds.shift[1]:
+        assert not np.array_equal(x[0], x[1])
+    # one-hot targets match class dirs
+    assert y[:4, 0].sum() == 4 and y[4:, 1].sum() == 4

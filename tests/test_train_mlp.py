@@ -18,14 +18,15 @@ from distributed_deep_learning_tpu.models.mlp import MLP
 from distributed_deep_learning_tpu.runtime.mesh import build_mesh
 from distributed_deep_learning_tpu.train.loop import fit
 from distributed_deep_learning_tpu.train.objectives import cross_entropy_loss
-from distributed_deep_learning_tpu.train.state import TrainState, reference_optimizer
+from distributed_deep_learning_tpu.train.state import (
+    create_train_state, reference_optimizer,
+)
 from distributed_deep_learning_tpu.train.step import make_step_fns, place_state
 from distributed_deep_learning_tpu.utils.logging import PhaseLogger
 
 
 def _init_state(model, example, tx, seed=42):
-    params = model.init(jax.random.key(seed), example)
-    return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+    return create_train_state(model, jax.random.key(seed), example, tx)
 
 
 def test_mlp_dp_learns(mesh8, capsys):
