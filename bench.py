@@ -1,0 +1,95 @@
+"""Headline benchmark: flagship CNN training throughput (images/sec/chip).
+
+Run on whatever devices JAX exposes (one real TPU chip under the driver;
+CPU elsewhere).  Prints ONE JSON line:
+``{"metric", "value", "unit", "vs_baseline"}``.
+
+The reference publishes no numbers (BASELINE.md) — the baseline here is this
+repo's own first recorded measurement, stored in ``bench_baseline.json`` the
+first time the benchmark runs on a given platform.  ``vs_baseline`` is
+value / stored-baseline (1.0 on the recording run).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributed_deep_learning_tpu.runtime.mesh import build_mesh
+    from distributed_deep_learning_tpu.train.objectives import (
+        cross_entropy_loss)
+    from distributed_deep_learning_tpu.train.state import create_train_state
+    from distributed_deep_learning_tpu.train.step import (make_step_fns,
+                                                          place_state)
+    from __graft_entry__ import _flagship
+
+    platform = jax.devices()[0].platform
+    n_chips = len(jax.devices())
+    mesh = build_mesh({"data": n_chips})
+
+    # PCB workload geometry (reference CNN/dataset.py: 64x64 crops, 6 classes)
+    batch = int(os.environ.get("BENCH_BATCH", 256 if platform == "tpu" else 32))
+    steps = int(os.environ.get("BENCH_STEPS", 30 if platform == "tpu" else 5))
+    dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
+    model = _flagship(dtype=dtype)
+
+    rng = np.random.default_rng(42)
+    x = jnp.asarray(rng.standard_normal((batch, 64, 64, 3), dtype=np.float32))
+    y = jax.nn.one_hot(jnp.asarray(rng.integers(0, 6, batch)), 6)
+
+    state = create_train_state(model, jax.random.key(0), x[:1],
+                               optax.sgd(0.01, momentum=0.9))
+    state = place_state(state, mesh)
+    train_step, _ = make_step_fns(mesh, cross_entropy_loss)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from distributed_deep_learning_tpu.data.loader import BATCH_AXES
+    sh = NamedSharding(mesh, P(BATCH_AXES))
+    x, y = jax.device_put(x, sh), jax.device_put(y, sh)
+
+    state, m = train_step(state, x, y)  # compile + warmup
+    state, m = train_step(state, x, y)
+    jax.block_until_ready(m)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = train_step(state, x, y)
+    jax.block_until_ready(m)
+    dt = time.perf_counter() - t0
+
+    ips_per_chip = batch * steps / dt / n_chips
+
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "bench_baseline.json")
+    baselines = {}
+    if os.path.exists(base_path):
+        with open(base_path) as f:
+            baselines = json.load(f)
+    key = f"{platform}:densenet_bc_train"
+    if key not in baselines:
+        baselines[key] = ips_per_chip
+        try:
+            with open(base_path, "w") as f:
+                json.dump(baselines, f, indent=1)
+        except OSError:
+            pass
+    vs = ips_per_chip / baselines[key] if baselines[key] else 1.0
+
+    print(json.dumps({
+        "metric": f"densenet_bc64 train images/sec/chip ({platform})",
+        "value": round(ips_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

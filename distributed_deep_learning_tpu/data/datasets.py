@@ -74,3 +74,69 @@ def synthetic_pdm(n: int = 4096, history: int = 10, num_features: int = 32,
     w = rng.normal(size=(num_features, num_targets)) / np.sqrt(num_features)
     y = (x.mean(axis=1) @ w).astype(np.float32)
     return ArrayDataset(x, y)
+
+
+# ---------------------------------------------------------------------------
+# North-star workload twins (BASELINE.json configs: MNIST CNN, ResNet-50 on
+# CIFAR-10/ImageNet, Transformer WMT, BERT MLM on C4).  Same contract as the
+# reference twins: identical shapes/dtypes, planted signal, host NumPy.
+# ---------------------------------------------------------------------------
+
+def synthetic_mnist(n: int = 2048, seed: int = 0) -> ArrayDataset:
+    """28×28×1 digits, one-hot 10-class targets (BASELINE config[0])."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, size=n)
+    x = rng.normal(size=(n, 28, 28, 1)).astype(np.float32)
+    x += labels[:, None, None, None].astype(np.float32) * 0.1
+    return ArrayDataset(x, np.eye(10, dtype=np.float32)[labels])
+
+
+def synthetic_cifar10(n: int = 2048, seed: int = 0) -> ArrayDataset:
+    """32×32×3 images, one-hot 10-class targets (BASELINE config[1])."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, size=n)
+    x = rng.normal(size=(n, 32, 32, 3)).astype(np.float32)
+    x += labels[:, None, None, None].astype(np.float32) * 0.1
+    return ArrayDataset(x, np.eye(10, dtype=np.float32)[labels])
+
+
+def synthetic_imagenet(n: int = 64, image_size: int = 224,
+                       num_classes: int = 1000, seed: int = 0) -> ArrayDataset:
+    """224×224×3 images, one-hot 1000-class targets (BASELINE config[2]).
+    Default ``n`` is small: one sample is 600 KB."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=n)
+    x = rng.normal(size=(n, image_size, image_size, 3)).astype(np.float32)
+    # planted signal (same contract as the other twins): class-dependent
+    # mean shift, spaced so a global-mean probe can separate classes
+    x += (labels[:, None, None, None].astype(np.float32) / num_classes) * 2.0
+    return ArrayDataset(x, np.eye(num_classes, dtype=np.float32)[labels])
+
+
+def synthetic_wmt(n: int = 1024, src_len: int = 32, tgt_len: int = 32,
+                  vocab_size: int = 32000, seed: int = 0) -> ArrayDataset:
+    """Token-id pairs shaped like a bucketed WMT batch (BASELINE config[3]).
+    ``features`` = source ids, ``targets`` = target ids; 0 is pad — ids are
+    drawn from [1, vocab) with a ragged tail of pads per row."""
+    rng = np.random.default_rng(seed)
+    src = rng.integers(1, vocab_size, size=(n, src_len))
+    tgt = rng.integers(1, vocab_size, size=(n, tgt_len))
+    for row, (ls, lt) in enumerate(zip(
+            rng.integers(src_len // 2, src_len + 1, size=n),
+            rng.integers(tgt_len // 2, tgt_len + 1, size=n))):
+        src[row, ls:] = 0
+        tgt[row, lt:] = 0
+    return ArrayDataset(src.astype(np.int32), tgt.astype(np.int32))
+
+
+def synthetic_c4_mlm(n: int = 1024, seq_len: int = 64,
+                     vocab_size: int = 30522, mask_id: int = 103,
+                     mask_rate: float = 0.15, seed: int = 0) -> ArrayDataset:
+    """BERT MLM twin (BASELINE config[4]): ``features`` = token ids with
+    ``mask_rate`` of positions replaced by ``mask_id``; ``targets`` = the
+    original ids (loss sites are wherever features == mask_id)."""
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(1, vocab_size, size=(n, seq_len)).astype(np.int32)
+    masked = tokens.copy()
+    masked[rng.random(size=tokens.shape) < mask_rate] = mask_id
+    return ArrayDataset(masked, tokens)

@@ -5,3 +5,9 @@ from distributed_deep_learning_tpu.models.densenet import (  # noqa: F401
 from distributed_deep_learning_tpu.models.cnn_lstm import (  # noqa: F401
     CNNLSTM, cnn_lstm_layer_sequence,
 )
+from distributed_deep_learning_tpu.models.resnet import (  # noqa: F401
+    MnistCNN, ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+)
+from distributed_deep_learning_tpu.models.transformer import (  # noqa: F401
+    BertEncoder, TransformerSeq2Seq, bert_base, transformer_base,
+)

@@ -1,0 +1,178 @@
+"""ResNet family (v1.5) — the north-star image workload.
+
+The reference repo itself has no ResNet, but the driver-assigned target
+(`BASELINE.json`: "ResNet-50/ImageNet images/sec/chip") makes ResNet-50 the
+flagship benchmark model of this framework.  Architecture follows the
+standard torchvision/He-et-al. v1.5 recipe (stride-2 in the 3×3 of the
+bottleneck, not the 1×1), implemented TPU-first:
+
+* **NHWC** layout (TPU native), bf16-friendly: ``dtype`` controls compute
+  precision, parameters stay f32 (Flax default param_dtype).
+* BatchNorm statistics span the *global* sharded batch under jit+sharding
+  (see :mod:`.densenet` — same reasoning).
+* No data-dependent control flow; the whole net is one straight-line traced
+  program that XLA tiles onto the MXU.
+* The residual trunk is also exposed as a homogeneous stage sequence
+  (:func:`resnet_layer_sequence`) so the model/pipeline partitioners
+  (:mod:`..parallel.partition`) can stage it like every other workload.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+conv_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+def _bn(dtype, name=None, scale_init=None):
+    return nn.BatchNorm(use_running_average=None, momentum=0.9, epsilon=1e-5,
+                        dtype=dtype, name=name,
+                        scale_init=scale_init or nn.initializers.ones)
+
+
+class BottleneckBlock(nn.Module):
+    """1×1 → 3×3(stride) → 1×1(4×) with projection shortcut when needed."""
+
+    features: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.features, (1, 1), use_bias=False,
+                    kernel_init=conv_init, dtype=self.dtype)(x)
+        y = _bn(self.dtype)(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), (self.strides, self.strides),
+                    use_bias=False, kernel_init=conv_init, dtype=self.dtype)(y)
+        y = _bn(self.dtype)(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = nn.Conv(self.features * 4, (1, 1), use_bias=False,
+                    kernel_init=conv_init, dtype=self.dtype)(y)
+        # zero-init the last BN scale: residual branches start as identity
+        # (standard ResNet recipe; improves large-batch training)
+        y = _bn(self.dtype, scale_init=nn.initializers.zeros)(
+            y, use_running_average=not train)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.features * 4, (1, 1),
+                               (self.strides, self.strides), use_bias=False,
+                               kernel_init=conv_init, dtype=self.dtype,
+                               name="proj")(residual)
+            residual = _bn(self.dtype, name="proj_bn")(
+                residual, use_running_average=not train)
+        return nn.relu(residual + y)
+
+
+class BasicBlock(nn.Module):
+    """3×3 → 3×3 (ResNet-18/34)."""
+
+    features: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.features, (3, 3), (self.strides, self.strides),
+                    use_bias=False, kernel_init=conv_init, dtype=self.dtype)(x)
+        y = _bn(self.dtype)(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), use_bias=False,
+                    kernel_init=conv_init, dtype=self.dtype)(y)
+        y = _bn(self.dtype, scale_init=nn.initializers.zeros)(
+            y, use_running_average=not train)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.features, (1, 1),
+                               (self.strides, self.strides), use_bias=False,
+                               kernel_init=conv_init, dtype=self.dtype,
+                               name="proj")(residual)
+            residual = _bn(self.dtype, name="proj_bn")(
+                residual, use_running_average=not train)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """ImageNet-shaped ResNet.  ``stage_sizes``/``block_cls`` select depth.
+
+    ``small_inputs=True`` swaps the 7×7-s2 + maxpool stem for a 3×3-s1 stem
+    (the standard CIFAR adaptation, used by the CIFAR-10 BASELINE config).
+    """
+
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)  # ResNet-50
+    block_cls: type = BottleneckBlock
+    num_classes: int = 1000
+    width: int = 64
+    small_inputs: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        if self.small_inputs:
+            x = nn.Conv(self.width, (3, 3), use_bias=False,
+                        kernel_init=conv_init, dtype=self.dtype,
+                        name="stem_conv")(x)
+        else:
+            x = nn.Conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                        use_bias=False, kernel_init=conv_init,
+                        dtype=self.dtype, name="stem_conv")(x)
+        x = _bn(self.dtype, name="stem_bn")(x, use_running_average=not train)
+        x = nn.relu(x)
+        if not self.small_inputs:
+            x = nn.max_pool(x, (3, 3), (2, 2), padding=((1, 1), (1, 1)))
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(self.width * 2 ** i, strides,
+                                   dtype=self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     kernel_init=nn.initializers.variance_scaling(
+                         1.0, "fan_in", "truncated_normal"))(x)
+        return x.astype(jnp.float32)
+
+
+def resnet18(**kw) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock, **kw)
+
+
+def resnet34(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock, **kw)
+
+
+def resnet50(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock, **kw)
+
+
+def resnet101(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 23, 3), block_cls=BottleneckBlock, **kw)
+
+
+def resnet152(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 8, 36, 3), block_cls=BottleneckBlock, **kw)
+
+
+class MnistCNN(nn.Module):
+    """BASELINE config[0]: the classic MNIST conv net (conv-pool ×2 → MLP).
+
+    Small smoke-test model mirroring the torch reference trainers'
+    entry-level workload; runs in seconds on CPU."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(32, (3, 3), dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), (2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3), dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), (2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(128, dtype=self.dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

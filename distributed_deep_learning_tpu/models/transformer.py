@@ -1,0 +1,213 @@
+"""Transformer models — BASELINE configs[3,4] (WMT seq2seq, BERT MLM).
+
+TPU-first design decisions:
+
+* One :class:`TransformerLayer` definition serves encoder (bidirectional),
+  decoder (causal + cross-attention) and BERT (bidirectional) — the
+  homogeneous-stack shape that the SPMD pipeline
+  (:mod:`..parallel.spmd_pipeline`) and tensor-parallel sharding rules
+  (:mod:`..parallel.tp`) both want.
+* ``attention_fn`` is pluggable: dense softmax attention by default;
+  :mod:`..ops.ring_attention` (sequence-parallel ppermute ring) or the
+  Pallas flash kernel slot in without touching the model.
+* bf16 compute / f32 params via ``dtype``; logits always f32.
+* Fixed shapes, no data-dependent control flow: causal masking is a static
+  triangular mask, padding via additive masks — everything jit-tileable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+AttentionFn = Callable[..., jnp.ndarray]
+dense_init = nn.initializers.xavier_uniform()
+
+
+def dot_product_attention(q, k, v, *, mask=None, dtype=jnp.float32):
+    """Plain softmax attention; q/k/v are (B, T, H, D)."""
+    depth = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(depth)
+    if mask is not None:
+        # -1e9, not finfo(f32).min: the latter overflows to -inf in bf16
+        # (same exponent range, smaller mantissa → rounds past bf16 max) and
+        # a fully-padded row would softmax to NaN; -1e9 degrades to uniform
+        # attention on such rows, which the loss masks out anyway.
+        logits = jnp.where(mask, logits, jnp.asarray(-1e9, logits.dtype))
+    weights = nn.softmax(logits.astype(jnp.float32)).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+class MultiHeadAttention(nn.Module):
+    num_heads: int
+    dtype: jnp.dtype = jnp.float32
+    attention_fn: Optional[AttentionFn] = None
+
+    @nn.compact
+    def __call__(self, x_q, x_kv, mask=None):
+        d_model = x_q.shape[-1]
+        head_dim = d_model // self.num_heads
+        proj = lambda name: nn.DenseGeneral(  # noqa: E731
+            (self.num_heads, head_dim), dtype=self.dtype,
+            kernel_init=dense_init, name=name)
+        q, k, v = proj("q")(x_q), proj("k")(x_kv), proj("v")(x_kv)
+        attn = self.attention_fn or dot_product_attention
+        y = attn(q, k, v, mask=mask, dtype=self.dtype)
+        return nn.DenseGeneral(d_model, axis=(-2, -1), dtype=self.dtype,
+                               kernel_init=dense_init, name="out")(y)
+
+
+class TransformerLayer(nn.Module):
+    """Pre-LN block: [self-attn] → [cross-attn]? → [MLP], residuals."""
+
+    num_heads: int = 8
+    mlp_dim: int = 2048
+    dropout_rate: float = 0.1
+    causal: bool = False
+    cross_attention: bool = False
+    dtype: jnp.dtype = jnp.float32
+    attention_fn: Optional[AttentionFn] = None
+
+    @nn.compact
+    def __call__(self, x, encoded=None, *, self_mask=None, cross_mask=None,
+                 train: bool = False):
+        mask = self_mask
+        if self.causal:
+            T = x.shape[1]
+            causal = jnp.tril(jnp.ones((T, T), bool))[None, None]
+            mask = causal if mask is None else jnp.logical_and(mask, causal)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = MultiHeadAttention(self.num_heads, self.dtype, self.attention_fn,
+                               name="self_attn")(h, h, mask)
+        h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+        x = x + h
+        if self.cross_attention:
+            h = nn.LayerNorm(dtype=self.dtype)(x)
+            h = MultiHeadAttention(self.num_heads, self.dtype,
+                                   self.attention_fn,
+                                   name="cross_attn")(h, encoded, cross_mask)
+            h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+            x = x + h
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype, kernel_init=dense_init)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(x.shape[-1], dtype=self.dtype, kernel_init=dense_init)(h)
+        h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+        return x + h
+
+
+class Embed(nn.Module):
+    vocab_size: int
+    d_model: int
+    max_len: int = 4096
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens):
+        emb = nn.Embed(self.vocab_size, self.d_model,
+                       embedding_init=nn.initializers.normal(0.02),
+                       dtype=self.dtype, name="tok")
+        pos = self.param("pos", nn.initializers.normal(0.02),
+                         (self.max_len, self.d_model))
+        x = emb(tokens) + pos[None, :tokens.shape[1]].astype(self.dtype)
+        return x, emb
+
+    @staticmethod
+    def logits(x, emb):
+        """Weight-tied output projection."""
+        return emb.attend(x.astype(emb.embedding.dtype)).astype(jnp.float32)
+
+
+class TransformerSeq2Seq(nn.Module):
+    """Transformer-base encoder-decoder (WMT14 en-de shape).
+
+    ``__call__(batch)`` with ``batch = {"inputs": (B,S), "targets": (B,T)}``
+    (token ids, 0 = pad) does teacher-forced training: returns logits over
+    the target vocabulary at every target position.
+    """
+
+    vocab_size: int = 32000
+    num_layers: int = 6
+    d_model: int = 512
+    num_heads: int = 8
+    mlp_dim: int = 2048
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+    attention_fn: Optional[AttentionFn] = None
+
+    @nn.compact
+    def __call__(self, batch, train: bool = False):
+        inputs, targets = batch["inputs"], batch["targets"]
+        src_pad = (inputs != 0)[:, None, None, :]   # (B,1,1,S)
+        tgt_pad = (targets != 0)[:, None, None, :]  # (B,1,1,T)
+
+        # one shared-vocabulary embedding for source, target and the
+        # (weight-tied) output projection — the transformer-base recipe
+        embed = Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                      name="embed")
+        x, emb = embed(inputs)
+        for i in range(self.num_layers):
+            x = TransformerLayer(self.num_heads, self.mlp_dim,
+                                 self.dropout_rate, dtype=self.dtype,
+                                 attention_fn=self.attention_fn,
+                                 name=f"enc_{i}")(x, self_mask=src_pad,
+                                                  train=train)
+        encoded = nn.LayerNorm(dtype=self.dtype, name="enc_norm")(x)
+
+        # shift right: BOS-from-zero teacher forcing
+        y_in = jnp.pad(targets, ((0, 0), (1, 0)))[:, :-1]
+        y, _ = embed(y_in)
+        for i in range(self.num_layers):
+            y = TransformerLayer(self.num_heads, self.mlp_dim,
+                                 self.dropout_rate, causal=True,
+                                 cross_attention=True, dtype=self.dtype,
+                                 attention_fn=self.attention_fn,
+                                 name=f"dec_{i}")(y, encoded,
+                                                  self_mask=tgt_pad,
+                                                  cross_mask=src_pad,
+                                                  train=train)
+        y = nn.LayerNorm(dtype=self.dtype, name="dec_norm")(y)
+        return Embed.logits(y, emb)
+
+
+class BertEncoder(nn.Module):
+    """BERT-base-shaped bidirectional encoder with an MLM head
+    (BASELINE config[4]: MLM pretrain, pjit 2D mesh + ZeRO-1)."""
+
+    vocab_size: int = 30522
+    num_layers: int = 12
+    d_model: int = 768
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+    attention_fn: Optional[AttentionFn] = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        pad = (tokens != 0)[:, None, None, :]
+        x, emb = Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                       name="embed")(tokens)
+        for i in range(self.num_layers):
+            x = TransformerLayer(self.num_heads, self.mlp_dim,
+                                 self.dropout_rate, dtype=self.dtype,
+                                 attention_fn=self.attention_fn,
+                                 name=f"layer_{i}")(x, self_mask=pad,
+                                                    train=train)
+        x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
+        # MLM head: dense + gelu + norm, weight-tied vocab projection
+        h = nn.Dense(self.d_model, dtype=self.dtype, name="mlm_dense")(x)
+        h = nn.gelu(h)
+        h = nn.LayerNorm(dtype=self.dtype, name="mlm_norm")(h)
+        return Embed.logits(h, emb)
+
+
+def transformer_base(**kw) -> TransformerSeq2Seq:
+    return TransformerSeq2Seq(**kw)
+
+
+def bert_base(**kw) -> BertEncoder:
+    return BertEncoder(**kw)

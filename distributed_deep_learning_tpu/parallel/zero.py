@@ -1,0 +1,80 @@
+"""ZeRO-style state sharding as pjit sharding rules (no new step code).
+
+BASELINE.json config[4] asks for a "pjit 2D mesh, ZeRO-1-style optimizer
+shard".  On TPU this is not a new algorithm but a *sharding annotation*: the
+train step (:mod:`..train.step`) is already one jitted program threading a
+``TrainState`` pytree; handing jit a sharded spec for ``opt_state`` makes
+XLA's SPMD partitioner reduce-scatter gradients into the shard, update
+sharded, and all-gather updated params — the ZeRO-1 dataflow — entirely via
+compiler-inserted ICI collectives.  Sharding params too (``fsdp_spec``)
+gives the ZeRO-3/FSDP dataflow the same way.
+
+Rules are computed per-leaf: shard the largest dimension divisible by the
+``fsdp`` axis size, leave small leaves (below ``min_leaf_size`` elements)
+replicated — sub-tile leaves only add collective latency.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_deep_learning_tpu.train.state import TrainState
+
+
+def leaf_shard_spec(leaf: Any, axis_size: int, axis: str = "fsdp",
+                    min_leaf_size: int = 2 ** 14) -> P:
+    """Spec sharding `leaf`'s largest divisible dim over `axis`."""
+    shape = getattr(leaf, "shape", ())
+    if not shape or axis_size <= 1:
+        return P()
+    if math.prod(shape) < min_leaf_size:
+        return P()
+    dims = sorted(range(len(shape)), key=lambda d: -shape[d])
+    for d in dims:
+        if shape[d] % axis_size == 0:
+            spec = [None] * len(shape)
+            spec[d] = axis
+            return P(*spec)
+    return P()
+
+
+def _tree_specs(tree: Any, axis_size: int, axis: str,
+                min_leaf_size: int) -> Any:
+    return jax.tree.map(
+        lambda l: leaf_shard_spec(l, axis_size, axis, min_leaf_size), tree)
+
+
+def _replicated(tree: Any) -> Any:
+    return jax.tree.map(lambda _: P(), tree)
+
+
+def zero1_state_spec(state: TrainState, mesh: Mesh, *, axis: str = "fsdp",
+                     min_leaf_size: int = 2 ** 14) -> TrainState:
+    """ZeRO-1: optimizer state sharded over `axis`; params replicated.
+
+    Returns a TrainState-shaped pytree of PartitionSpecs for
+    :func:`..train.step.make_step_fns`'s ``state_spec``.
+    """
+    n = mesh.shape.get(axis, 1)
+    return state.replace(
+        step=P(),
+        params=_replicated(state.params),
+        model_state=_replicated(state.model_state),
+        opt_state=_tree_specs(state.opt_state, n, axis, min_leaf_size),
+    )
+
+
+def fsdp_state_spec(state: TrainState, mesh: Mesh, *, axis: str = "fsdp",
+                    min_leaf_size: int = 2 ** 14) -> TrainState:
+    """ZeRO-3/FSDP: params AND optimizer state sharded over `axis`."""
+    n = mesh.shape.get(axis, 1)
+    return state.replace(
+        step=P(),
+        params=_tree_specs(state.params, n, axis, min_leaf_size),
+        model_state=_replicated(state.model_state),
+        opt_state=_tree_specs(state.opt_state, n, axis, min_leaf_size),
+    )

@@ -40,5 +40,14 @@ def l1_loss(pred: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
 
 
 def argmax_correct(pred: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
-    """Count of argmax matches in the batch (reference accuracy numerator)."""
-    return jnp.sum(jnp.argmax(pred, axis=-1) == jnp.argmax(targets, axis=-1))
+    """Count of argmax matches in the batch (reference accuracy numerator).
+
+    ``targets`` may be one-hot(ish) vectors (reference style) or integer
+    class ids of one fewer dimension (token-level models, e.g. MLM)."""
+    pred_cls = jnp.argmax(pred, axis=-1)
+    if (targets.ndim == pred_cls.ndim
+            and jnp.issubdtype(targets.dtype, jnp.integer)):
+        tgt_cls = targets
+    else:
+        tgt_cls = jnp.argmax(targets, axis=-1)
+    return jnp.sum(pred_cls == tgt_cls)

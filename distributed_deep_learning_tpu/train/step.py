@@ -14,6 +14,7 @@ consequence of sharding: replicated-out params + sharded-in batch ⇒ psum.
 
 from __future__ import annotations
 
+import math
 from typing import Callable
 
 import jax
@@ -27,23 +28,37 @@ from distributed_deep_learning_tpu.train.state import TrainState
 LossFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
 
 
+def _state_sharding(mesh: Mesh, state_spec):
+    """A single PartitionSpec broadcasts over the whole state; a
+    TrainState-shaped pytree of specs (e.g. from
+    :func:`..parallel.zero.zero1_state_spec`) shards per leaf."""
+    if isinstance(state_spec, P):
+        return NamedSharding(mesh, state_spec)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), state_spec)
+
+
 def make_step_fns(mesh: Mesh, loss_fn: LossFn, *,
                   state_spec=P(), batch_spec=P(BATCH_AXES)):
     """Build (train_step, eval_step), jitted with explicit shardings.
 
     ``state_spec`` defaults to fully-replicated parameters/optimizer state
-    (pure DP).  ZeRO-1 passes a sharded opt-state rule instead; the step body
-    is identical — only the shardings change.
+    (pure DP).  ZeRO-1/FSDP pass a sharded per-leaf spec pytree instead
+    (:mod:`..parallel.zero`); the step body is identical — only the
+    shardings change, and XLA inserts the reduce-scatter/all-gather
+    dataflow those schemes describe.
     """
-    state_sh = NamedSharding(mesh, state_spec)
+    state_sh = _state_sharding(mesh, state_spec)
     batch_sh = NamedSharding(mesh, batch_spec)
     repl = NamedSharding(mesh, P())
 
     def _metrics(pred, y, loss):
+        # prediction sites = every argmax position: B for (B,C) classifiers
+        # (the reference's per-sample count), B*T for token-level models
+        n_sites = math.prod(pred.shape[:-1])
         return {
             "loss": loss,
             "correct": argmax_correct(pred, y).astype(jnp.int32),
-            "count": jnp.asarray(y.shape[0], jnp.int32),
+            "count": jnp.asarray(n_sites, jnp.int32),
         }
 
     def train_step(state: TrainState, x, y):
@@ -78,5 +93,4 @@ def make_step_fns(mesh: Mesh, loss_fn: LossFn, *,
 
 def place_state(state: TrainState, mesh: Mesh, state_spec=P()) -> TrainState:
     """Put freshly-initialised state onto the mesh with its sharding."""
-    sh = NamedSharding(mesh, state_spec)
-    return jax.device_put(state, sh)
+    return jax.device_put(state, _state_sharding(mesh, state_spec))
