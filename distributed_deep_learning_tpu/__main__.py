@@ -1,0 +1,31 @@
+"""Package entry point: ``python -m distributed_deep_learning_tpu <workload>``.
+
+The reference is launched per-workload (``python CNN/main.py -m data ...``);
+the equivalent here is ``python -m distributed_deep_learning_tpu cnn -m data
+...`` with the identical flag surface (``-l -s -e -b -d -w -m -p -r``).
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv: list[str] | None = None) -> None:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    from distributed_deep_learning_tpu.workloads import WORKLOADS
+
+    if not argv or argv[0] in ("-h", "--help"):
+        print(f"usage: python -m distributed_deep_learning_tpu "
+              f"{{{'|'.join(WORKLOADS)}}} [flags]\n"
+              f"Run '<workload> -h' for the per-workload flag reference.")
+        return
+    name, rest = argv[0], argv[1:]
+    from distributed_deep_learning_tpu.utils.config import parse_args
+    from distributed_deep_learning_tpu.workloads import get_spec, run_workload
+
+    spec = get_spec(name)
+    run_workload(spec, parse_args(rest, workload=name))
+
+
+if __name__ == "__main__":
+    main()

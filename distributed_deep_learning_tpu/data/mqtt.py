@@ -1,0 +1,33 @@
+"""MQTT intrusion-detection CSV loader (the MLP workload's dataset).
+
+Reference semantics (``src/pytorch/MLP/dataset.py:24-37``): read the CSV
+with pandas, drop the first (index) column; each row is features
+``data[:-5]`` + a 5-wide one-hot-ish target ``data[-5:]``.  The reference
+moved every row to device inside ``__getitem__``; here rows stay host-side
+NumPy and batching/device placement happen in :mod:`.loader` (SURVEY.md
+§3.5).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from distributed_deep_learning_tpu.data.datasets import ArrayDataset
+
+NUM_TARGETS = 5
+
+
+def load_mqtt(path: str = "/data/MQTT/dataset.csv") -> ArrayDataset:
+    """Load the real CSV; raises FileNotFoundError when /data is absent
+    (callers fall back to :func:`..datasets.synthetic_mqtt`)."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{path} not found — use data.datasets.synthetic_mqtt for the "
+            "shape-compatible synthetic twin")
+    import pandas as pd
+
+    frame = pd.read_csv(path, low_memory=False)
+    data = frame.values[:, 1:].astype(np.float32)  # drop index column
+    return ArrayDataset(data[:, :-NUM_TARGETS], data[:, -NUM_TARGETS:])

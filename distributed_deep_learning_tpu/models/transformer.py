@@ -117,8 +117,14 @@ class Embed(nn.Module):
 
     @staticmethod
     def logits(x, emb):
-        """Weight-tied output projection."""
-        return emb.attend(x.astype(emb.embedding.dtype)).astype(jnp.float32)
+        """Weight-tied output projection, accumulated in f32.
+
+        Not ``emb.attend``: Flax's attend re-casts both operands to the
+        module dtype, so under bf16 the vocab-wide matmul would accumulate
+        in bf16 — here the cast to f32 happens *before* the contraction.
+        """
+        table = jnp.asarray(emb.embedding, jnp.float32)
+        return jnp.einsum("...d,vd->...v", x.astype(jnp.float32), table)
 
 
 class TransformerSeq2Seq(nn.Module):

@@ -43,11 +43,34 @@ def argmax_correct(pred: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
     """Count of argmax matches in the batch (reference accuracy numerator).
 
     ``targets`` may be one-hot(ish) vectors (reference style) or integer
-    class ids of one fewer dimension (token-level models, e.g. MLM)."""
+    class ids of one fewer dimension (token-level models, e.g. MLM).
+    Integer targets equal to 0 are treated as padding and excluded
+    (matching :func:`prediction_metrics`' count)."""
+    correct, _ = _correct_and_count(pred, targets)
+    return correct
+
+
+def _correct_and_count(pred: jnp.ndarray, targets: jnp.ndarray
+                       ) -> tuple[jnp.ndarray, jnp.ndarray]:
     pred_cls = jnp.argmax(pred, axis=-1)
     if (targets.ndim == pred_cls.ndim
             and jnp.issubdtype(targets.dtype, jnp.integer)):
-        tgt_cls = targets
-    else:
-        tgt_cls = jnp.argmax(targets, axis=-1)
-    return jnp.sum(pred_cls == tgt_cls)
+        # token-level: id 0 is pad — pad sites are neither correct nor counted
+        valid = targets != 0
+        correct = jnp.sum((pred_cls == targets) & valid)
+        return correct, jnp.sum(valid).astype(jnp.int32)
+    tgt_cls = jnp.argmax(targets, axis=-1)
+    import math
+    n_sites = math.prod(pred.shape[:-1])
+    return jnp.sum(pred_cls == tgt_cls), jnp.asarray(n_sites, jnp.int32)
+
+
+def prediction_metrics(pred: jnp.ndarray, targets: jnp.ndarray,
+                       loss: jnp.ndarray) -> dict:
+    """The phase-metric triple every step builder emits: batch loss, argmax
+    matches, and prediction-site count (per-sample for (B,C) classifiers —
+    the reference's denominator, ``CNN/main.py:90-94`` — per non-pad token
+    for token-level models)."""
+    correct, count = _correct_and_count(pred, targets)
+    return {"loss": loss, "correct": correct.astype(jnp.int32),
+            "count": count}

@@ -14,7 +14,6 @@ consequence of sharding: replicated-out params + sharded-in batch ⇒ psum.
 
 from __future__ import annotations
 
-import math
 from typing import Callable
 
 import jax
@@ -22,7 +21,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_deep_learning_tpu.data.loader import BATCH_AXES
-from distributed_deep_learning_tpu.train.objectives import argmax_correct
+from distributed_deep_learning_tpu.train.objectives import prediction_metrics
 from distributed_deep_learning_tpu.train.state import TrainState
 
 LossFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
@@ -51,15 +50,7 @@ def make_step_fns(mesh: Mesh, loss_fn: LossFn, *,
     batch_sh = NamedSharding(mesh, batch_spec)
     repl = NamedSharding(mesh, P())
 
-    def _metrics(pred, y, loss):
-        # prediction sites = every argmax position: B for (B,C) classifiers
-        # (the reference's per-sample count), B*T for token-level models
-        n_sites = math.prod(pred.shape[:-1])
-        return {
-            "loss": loss,
-            "correct": argmax_correct(pred, y).astype(jnp.int32),
-            "count": jnp.asarray(n_sites, jnp.int32),
-        }
+    _metrics = prediction_metrics
 
     def train_step(state: TrainState, x, y):
         def compute(params):

@@ -1,0 +1,22 @@
+"""Workload registry: the reference's backend contract (SURVEY.md §2.6) —
+a backend provides the three workloads {MLP, CNN, LSTM} behind one CLI."""
+
+from distributed_deep_learning_tpu.workloads.base import (  # noqa: F401
+    StagedTrainer, WorkloadSpec, run_workload)
+
+
+def get_spec(name: str):
+    """Late-import specs so `import workloads` stays cheap."""
+    name = name.lower()
+    if name == "mlp":
+        from distributed_deep_learning_tpu.workloads.mlp import SPEC
+    elif name == "cnn":
+        from distributed_deep_learning_tpu.workloads.cnn import SPEC
+    elif name == "lstm":
+        from distributed_deep_learning_tpu.workloads.lstm import SPEC
+    else:
+        raise ValueError(f"unknown workload {name!r}; choose mlp|cnn|lstm")
+    return SPEC
+
+
+WORKLOADS = ("mlp", "cnn", "lstm")

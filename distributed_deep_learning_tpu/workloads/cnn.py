@@ -1,0 +1,73 @@
+"""CNN workload: DenseNet-BC on PCB defects (reference ``src/pytorch/CNN``).
+
+``-l`` = dense block count, ``-s`` = bottleneck size, matching the reference
+CLI (``CNN/main.py:49-50``).  Optimizer/schedule: SGD(0.01, momentum 0.9) +
+step decay ×0.1 every 7 epochs (``CNN/main.py:160-161``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from distributed_deep_learning_tpu.data.datasets import synthetic_pcb
+from distributed_deep_learning_tpu.data.pcb import PCBDataset
+from distributed_deep_learning_tpu.models.densenet import (
+    DenseNet, densenet_layer_sequence)
+from distributed_deep_learning_tpu.parallel.partition import block_partition
+from distributed_deep_learning_tpu.train.objectives import cross_entropy_loss
+from distributed_deep_learning_tpu.train.state import reference_optimizer
+from distributed_deep_learning_tpu.utils.config import Config, parse_args
+from distributed_deep_learning_tpu.workloads.base import (
+    WorkloadSpec, config_dtype, example_from_dataset, run_workload)
+
+NUM_CLASSES = 6  # PCB defect classes (reference CNN/dataset.py class dirs)
+
+
+def _dataset(config: Config):
+    try:
+        return PCBDataset(seed=config.seed)
+    except FileNotFoundError:
+        return synthetic_pcb(seed=config.seed, num_classes=NUM_CLASSES)
+
+
+def _model(config: Config, dataset):
+    return DenseNet(dense_blocks=config.num_layers, bn_size=config.size,
+                    num_classes=NUM_CLASSES,
+                    double_softmax=config.double_softmax,
+                    dtype=config_dtype(config))
+
+
+def _layers(config: Config, dataset):
+    return densenet_layer_sequence(
+        dense_blocks=config.num_layers, bn_size=config.size,
+        num_classes=NUM_CLASSES, double_softmax=config.double_softmax,
+        dtype=config_dtype(config))
+
+
+def _loss(config: Config):
+    if config.double_softmax:
+        return lambda p, t: cross_entropy_loss(p, t, from_probabilities=True)
+    return cross_entropy_loss
+
+
+SPEC = WorkloadSpec(
+    name="cnn",
+    build_dataset=_dataset,
+    build_model=_model,
+    build_layers=_layers,
+    partitioner=block_partition,  # reference CNN/model.py:196-201 ({i: i//4})
+    build_loss=_loss,
+    build_optimizer=lambda c, steps: reference_optimizer(
+        "cnn", c.learning_rate if c.learning_rate != 1e-3 else None,
+        epoch_steps=steps),
+    example_input=example_from_dataset,
+)
+
+
+def main(argv=None):
+    config = parse_args(argv, workload="cnn")
+    return run_workload(SPEC, config)
+
+
+if __name__ == "__main__":
+    main()

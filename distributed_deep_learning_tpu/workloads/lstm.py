@@ -1,0 +1,65 @@
+"""LSTM workload: CNN-LSTM on predictive maintenance (reference
+``src/pytorch/LSTM``).
+
+``-l`` = hidden LSTM layers, ``-s`` = hidden width (``LSTM/main.py:55-56``).
+Loss is L1 over the 5 raw sensor targets while "accuracy" reports argmax
+matches — reference quirk Q5, replicated as the workload definition.
+The reference *never* synced gradients for this workload even under MPI
+(quirk Q2); here `data` mode syncs like every other workload — pass
+``--no-sync`` to reproduce the reference behaviour.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from distributed_deep_learning_tpu.data.datasets import synthetic_pdm
+from distributed_deep_learning_tpu.data.pdm import load_pdm
+from distributed_deep_learning_tpu.models.cnn_lstm import (
+    CNNLSTM, cnn_lstm_layer_sequence)
+from distributed_deep_learning_tpu.parallel.partition import lstm_aware_partition
+from distributed_deep_learning_tpu.train.objectives import l1_loss
+from distributed_deep_learning_tpu.train.state import reference_optimizer
+from distributed_deep_learning_tpu.utils.config import Config, parse_args
+from distributed_deep_learning_tpu.workloads.base import (
+    WorkloadSpec, config_dtype, example_from_dataset, run_workload)
+
+NUM_TARGETS = 5
+
+
+def _dataset(config: Config):
+    try:
+        return load_pdm()
+    except FileNotFoundError:
+        return synthetic_pdm(seed=config.seed)
+
+
+def _model(config: Config, dataset):
+    return CNNLSTM(hidden_layers=config.num_layers, hidden_size=config.size,
+                   num_targets=NUM_TARGETS, dtype=config_dtype(config))
+
+
+def _layers(config: Config, dataset):
+    return cnn_lstm_layer_sequence(config.num_layers, config.size,
+                                   NUM_TARGETS, dtype=config_dtype(config))
+
+
+SPEC = WorkloadSpec(
+    name="lstm",
+    build_dataset=_dataset,
+    build_model=_model,
+    build_layers=_layers,
+    partitioner=lstm_aware_partition,  # reference LSTM/model.py:98-124
+    build_loss=lambda c: l1_loss,
+    build_optimizer=lambda c, steps: reference_optimizer("lstm", c.learning_rate),
+    example_input=example_from_dataset,
+)
+
+
+def main(argv=None):
+    config = parse_args(argv, workload="lstm")
+    return run_workload(SPEC, config)
+
+
+if __name__ == "__main__":
+    main()

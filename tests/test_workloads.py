@@ -1,0 +1,129 @@
+"""End-to-end workload runner: all three workloads × all four modes behind
+the reference CLI (the backend contract, SURVEY.md §2.6)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_deep_learning_tpu.utils.config import Mode, parse_args
+from distributed_deep_learning_tpu.workloads import get_spec, run_workload
+
+
+def _run(workload, argv, limit=1024):
+    """Run under a small DDL_DATA_LIMIT so staged (un-jitted outer loop)
+    modes stay fast on the CPU test platform."""
+    config = parse_args(argv, workload=workload)
+    old = os.environ.get("DDL_DATA_LIMIT")
+    os.environ["DDL_DATA_LIMIT"] = str(limit)
+    try:
+        return run_workload(get_spec(workload), config)
+    finally:
+        if old is None:
+            os.environ.pop("DDL_DATA_LIMIT", None)
+        else:
+            os.environ["DDL_DATA_LIMIT"] = old
+
+
+def _history_ok(history):
+    phases = [h.phase for h in history]
+    assert phases[-1] == "test"
+    assert "train" in phases and "validation" in phases
+    for h in history:
+        assert np.isfinite(h.loss), f"{h.phase}: non-finite loss"
+
+
+# --- the reference's 4 modes on the minimum workload (MLP) -----------------
+
+def test_mlp_sequential():
+    _, history = _run("mlp", ["-e", "3", "-b", "64", "-m", "sequential"],
+                      limit=2048)
+    _history_ok(history)
+    train = [h for h in history if h.phase == "train"]
+    assert train[-1].accuracy > train[0].accuracy  # learns on planted signal
+    assert train[-1].accuracy > 40.0
+
+
+def test_mlp_data_parallel():
+    _, history = _run("mlp", ["-e", "2", "-b", "64", "-m", "data"])
+    _history_ok(history)
+
+
+def test_mlp_model_parallel():
+    _, history = _run("mlp", ["-l", "2", "-e", "1", "-b", "64", "-m", "model",
+                              "--nstages", "3"])
+    _history_ok(history)
+
+
+def test_mlp_pipeline():
+    # reference -p semantics: microbatch SIZE 16 over batch 64
+    _, history = _run("mlp", ["-l", "2", "-e", "1", "-b", "64", "-m",
+                              "pipeline", "-p", "16", "--nstages", "2"])
+    _history_ok(history)
+
+
+# --- CNN and LSTM workloads (one cheap mode each + one staged mode) --------
+
+def test_cnn_sequential_smoke():
+    _, history = _run("cnn", ["-l", "1", "-e", "1", "-b", "16", "-m",
+                              "sequential"])
+    _history_ok(history)
+
+
+def test_cnn_pipeline_smoke():
+    _, history = _run("cnn", ["-l", "2", "-e", "1", "-b", "16", "-m",
+                              "pipeline", "-p", "8", "--nstages", "2"])
+    _history_ok(history)
+
+
+def test_lstm_data_parallel():
+    _, history = _run("lstm", ["-e", "1", "-b", "64", "-m", "data"])
+    _history_ok(history)
+
+
+def test_lstm_model_parallel():
+    _, history = _run("lstm", ["-l", "3", "-e", "1", "-b", "64", "-m",
+                               "model", "--nstages", "4"])
+    _history_ok(history)
+
+
+# --- mode equivalence: staged modes compute the same function --------------
+
+def test_pipeline_mode_matches_model_mode():
+    """Same seed + same staging ⇒ model and pipeline modes produce identical
+    math (microbatching must not change results for BN-free models)."""
+    _, h_mp = _run("mlp", ["-l", "2", "-e", "1", "-b", "64", "-m", "model",
+                           "--nstages", "2"])
+    _, h_pp = _run("mlp", ["-l", "2", "-e", "1", "-b", "64", "-m", "pipeline",
+                           "-p", "16", "--nstages", "2"])
+    mp_train = [h for h in h_mp if h.phase == "train"][0]
+    pp_train = [h for h in h_pp if h.phase == "train"][0]
+    np.testing.assert_allclose(mp_train.loss, pp_train.loss, rtol=1e-5)
+    np.testing.assert_allclose(mp_train.accuracy, pp_train.accuracy, atol=1e-6)
+
+
+# --- quirk replication flags ----------------------------------------------
+
+def test_quirk_q1_no_sync_mode():
+    _, history = _run("mlp", ["-e", "1", "-b", "32", "-m", "data", "-r", "4",
+                              "--no-sync"])
+    _history_ok(history)
+
+
+def test_quirk_q4_double_softmax():
+    _, history = _run("mlp", ["-e", "1", "-b", "64", "--double-softmax"])
+    _history_ok(history)
+
+
+# --- CLI surface -----------------------------------------------------------
+
+def test_cli_defaults_match_reference():
+    c = parse_args([], workload="cnn")
+    assert c.epochs == 10 and c.batch_size == 32 and c.microbatch == 2
+    assert c.mode is Mode.SEQUENTIAL
+    assert c.num_layers == 2 and c.size == 4  # CNN/main.py:49-50
+
+
+def test_unknown_workload_raises():
+    with pytest.raises(ValueError):
+        get_spec("resnet9000")
