@@ -1,0 +1,149 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+Context parallelism for long sequences — the capability the reference lacks
+entirely (its only sequence model consumes 10-step windows,
+``LSTM/dataset.py:25``; SURVEY.md §2.5 lists SP/CP as absent) but which a
+TPU framework must treat as first-class: sequence length is the axis that
+outgrows a single chip's HBM first.
+
+Mechanism (Ring Attention with blockwise softmax): queries stay put, K/V
+blocks rotate around the ``seq`` mesh axis with ``lax.ppermute`` over ICI;
+each hop every device contracts its local queries against the visiting K/V
+block and folds the result into an online-softmax accumulator
+(running max ``m``, denominator ``l``, numerator ``acc`` — the
+flash-attention recurrence), so the full (T×T) score matrix never
+materialises and per-device memory is O(T/S · T/S) per hop.  After S hops
+every query has seen every key exactly once and the result equals full
+attention bit-for-near-bit.
+
+Communication and compute overlap naturally: the ppermute for hop r+1 is
+independent of hop r's contraction, so XLA can pipeline them over ICI.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # JAX >= 0.7 exports shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() well-defined
+
+
+def _block_attention(q, k, v, m, l, acc, q_start, k_start, causal):
+    """Fold one visiting K/V block into the online-softmax accumulator.
+
+    Shapes: q (B,H,Tq,D); k,v (B,H,Tk,D); m,l (B,H,Tq); acc (B,H,Tq,D).
+    ``q_start``/``k_start`` are the blocks' global sequence offsets (for the
+    causal mask across blocks).
+    """
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(q.shape[-1] * 1.0)
+    if causal:
+        q_pos = q_start + jnp.arange(q.shape[2])
+        k_pos = k_start + jnp.arange(k.shape[2])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    block_max = jnp.max(scores, axis=-1)
+    new_m = jnp.maximum(m, block_max)
+    correction = jnp.exp(m - new_m)
+    p = jnp.exp(scores - new_m[..., None])
+    new_l = l * correction + jnp.sum(p, axis=-1)
+    new_acc = acc * correction[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return new_m, new_l, new_acc
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                   mesh: Mesh, axis: str = "seq", causal: bool = False,
+                   batch_axes: tuple[str, ...] = ("data", "fsdp")
+                   ) -> jnp.ndarray:
+    """Exact multi-head attention with the sequence sharded over ``axis``.
+
+    Args:
+      q, k, v: global ``(B, T, H, D)`` arrays (sharded or not — the
+        shard_map partitions them: T over `axis`, B over `batch_axes`).
+      mesh: mesh containing `axis`; composes with data parallelism.
+      causal: standard autoregressive mask, applied across blocks via
+        global positions.
+
+    Returns ``(B, T, H, D)`` attention output, sharded like ``q``.
+    """
+    S = mesh.shape[axis]
+    B, T, H, D = q.shape
+    if T % S:
+        raise ValueError(f"sequence length {T} not divisible by {axis}={S}")
+
+    spec = P(batch_axes, axis, None, None)
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=spec, check_vma=False)
+    def run(q, k, v):
+        # local blocks: (B', Tl, H, D) → (B', H, Tl, D)
+        q_ = jnp.swapaxes(q, 1, 2)
+        k_ = jnp.swapaxes(k, 1, 2)
+        v_ = jnp.swapaxes(v, 1, 2)
+        Tl = q_.shape[2]
+        my = lax.axis_index(axis)
+        q_start = my * Tl
+
+        m0 = jnp.full(q_.shape[:3], NEG_INF, q_.dtype)
+        l0 = jnp.zeros(q_.shape[:3], q_.dtype)
+        acc0 = jnp.zeros_like(q_)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def hop(carry, r):
+            k_blk, v_blk, m, l, acc = carry
+            # the block visiting at hop r originated on device (my - r) mod S
+            k_start = ((my - r) % S) * Tl
+            m, l, acc = _block_attention(q_, k_blk, v_blk, m, l, acc,
+                                         q_start, k_start, causal)
+            k_blk = lax.ppermute(k_blk, axis, perm)
+            v_blk = lax.ppermute(v_blk, axis, perm)
+            return (k_blk, v_blk, m, l, acc), None
+
+        (_, _, m, l, acc), _ = lax.scan(
+            hop, (k_, v_, m0, l0, acc0), jnp.arange(S))
+        out = acc / l[..., None]
+        return jnp.swapaxes(out, 1, 2)
+
+    return run(q, k, v)
+
+
+def make_attention_fn(mesh: Mesh, axis: str = "seq", causal: bool = False):
+    """Adapter: ring attention as a ``MultiHeadAttention.attention_fn``.
+
+    The causal mask is computed internally from global block positions (the
+    (T×T) mask tensor the dense path builds would defeat the whole point),
+    so pass ``causal=True`` HERE and leave the layer's ``causal=False``.
+    Arbitrary (padding) masks are not supported yet — pad to block
+    boundaries instead.
+    """
+
+    def attn(q, k, v, *, mask=None, dtype=jnp.float32):
+        if mask is not None:
+            raise NotImplementedError(
+                "ring attention computes its causal mask internally from "
+                "global positions; explicit mask tensors are unsupported "
+                "(set causal=True on make_attention_fn, not on the layer)")
+        out = ring_attention(q, k, v, mesh=mesh, axis=axis, causal=causal)
+        return out.astype(dtype)
+
+    return attn
+
+
+def full_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                   causal: bool = False) -> jnp.ndarray:
+    """Single-device reference: softmax(qkᵀ/√d)v on ``(B, T, H, D)``."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(q.shape[-1] * 1.0)
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
