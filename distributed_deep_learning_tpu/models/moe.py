@@ -1,0 +1,157 @@
+"""Mixture-of-Experts MLP with expert parallelism over the ``expert`` axis.
+
+Beyond-reference capability (SURVEY.md §2.5 lists EP as absent): a
+GShard-style top-2 routed MLP whose expert weights are stacked along a
+leading E axis.  Under pjit, sharding that axis with
+``PartitionSpec("expert", ...)`` places one expert group per device and the
+dispatch/combine einsums lower to all-to-alls over ICI — expert parallelism
+is, like tensor parallelism, a sharding annotation rather than an engine.
+
+Dispatch is the dense one-hot formulation: a (tokens, E, C) dispatch mask
+and combine weights, contracted with the token stream.  O(T·E·C) memory but
+fully static shapes (XLA-friendly; no sorting, no dynamic slots), the
+standard TPU formulation.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+dense_init = nn.initializers.xavier_uniform()
+
+
+def top2_gating(logits: jnp.ndarray, capacity: int):
+    """GShard top-2 gating with capacity-limited dispatch.
+
+    Args:
+      logits: (G, E) router logits for G tokens (a flattened group).
+      capacity: per-expert slot count C.
+
+    Returns (dispatch (G, E, C) bool-ish float, combine (G, E, C) float,
+    aux_loss scalar).  Tokens overflowing an expert's capacity are dropped
+    for that expert (their combine weight is 0) — standard GShard semantics.
+    """
+    G, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # top-1 and top-2 expert per token
+    idx1 = jnp.argmax(probs, axis=-1)                       # (G,)
+    mask1 = jax.nn.one_hot(idx1, E)
+    probs_wo1 = probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs_wo1, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, E)
+
+    # load-balancing auxiliary loss (Shazeer/GShard: E * Σ fraction·prob)
+    density = jnp.mean(mask1, axis=0)                       # fraction routed
+    density_proxy = jnp.mean(probs, axis=0)                 # mean router prob
+    aux_loss = jnp.sum(density * density_proxy) * (E ** 2) / E
+
+    # position of each token within its expert's queue (capacity slots)
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1        # 0-based
+    # expert-2 queue continues after expert-1 assignments
+    pos2 = (jnp.cumsum(mask2, axis=0) - mask2
+            + jnp.sum(mask1, axis=0, keepdims=True)) * mask2
+    keep1 = mask1 * (pos1 < capacity)
+    keep2 = mask2 * (pos2 < capacity)
+
+    g1 = jnp.sum(probs * keep1, axis=-1)                    # (G,)
+    g2 = jnp.sum(probs * keep2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    slot1 = jax.nn.one_hot(jnp.sum(pos1, axis=-1).astype(jnp.int32),
+                           capacity)                        # (G, C)
+    slot2 = jax.nn.one_hot(jnp.sum(pos2, axis=-1).astype(jnp.int32),
+                           capacity)
+    dispatch = (keep1[..., None] * slot1[:, None, :]
+                + keep2[..., None] * slot2[:, None, :])      # (G, E, C)
+    combine = (g1[:, None, None] * keep1[..., None] * slot1[:, None, :]
+               + g2[:, None, None] * keep2[..., None] * slot2[:, None, :])
+    return dispatch, combine, aux_loss
+
+
+class MoEMLP(nn.Module):
+    """Top-2 routed MLP: ``x → router → all-to-all → expert FFN →
+    all-to-all → combine``.
+
+    Expert weights have shape (E, d_model, mlp_dim)/(E, mlp_dim, d_model);
+    shard the leading axis over ``expert`` (see
+    :func:`moe_param_rules`).  The auxiliary load-balance loss is sown into
+    the ``losses`` collection under ``moe_aux_loss``.
+    """
+
+    num_experts: int = 8
+    mlp_dim: int = 2048
+    capacity_factor: float = 2.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        B, T, d = x.shape
+        E = self.num_experts
+        G = B * T
+        capacity = max(1, int(self.capacity_factor * G / E))
+
+        tokens = x.reshape(G, d)
+        router = nn.Dense(E, dtype=jnp.float32, kernel_init=dense_init,
+                          name="router")
+        logits = router(tokens.astype(jnp.float32))
+        dispatch, combine, aux_loss = top2_gating(logits, capacity)
+        self.sow("losses", "moe_aux_loss", aux_loss)
+
+        w_in = self.param("w_in", dense_init, (E, d, self.mlp_dim),
+                          jnp.float32).astype(self.dtype)
+        w_out = self.param("w_out", dense_init, (E, self.mlp_dim, d),
+                           jnp.float32).astype(self.dtype)
+
+        # dispatch: (G,E,C)×(G,d) → (E,C,d)  [all-to-all under EP sharding]
+        expert_in = jnp.einsum("gec,gd->ecd", dispatch.astype(self.dtype),
+                               tokens.astype(self.dtype))
+        h = nn.gelu(jnp.einsum("ecd,edm->ecm", expert_in, w_in))
+        expert_out = jnp.einsum("ecm,emd->ecd", h, w_out)
+        # combine: (G,E,C)×(E,C,d) → (G,d)   [second all-to-all]
+        out = jnp.einsum("gec,ecd->gd", combine.astype(self.dtype),
+                         expert_out)
+        return out.reshape(B, T, d).astype(jnp.float32)
+
+
+def moe_param_rules(axis: str = "expert"):
+    """Sharding rules for :func:`..parallel.tensor_parallel.param_specs`:
+    expert-stacked weights shard their leading E axis; the router stays
+    replicated (every device routes its own tokens)."""
+    from jax.sharding import PartitionSpec as P
+
+    return (
+        (r"(^|.*/)w_in$", P(axis, None, None)),
+        (r"(^|.*/)w_out$", P(axis, None, None)),
+    )
+
+
+class MoETransformerLayer(nn.Module):
+    """Pre-LN transformer block whose MLP is a routed :class:`MoEMLP` —
+    the standard every-other-layer MoE substitution unit."""
+
+    num_heads: int = 8
+    num_experts: int = 8
+    mlp_dim: int = 2048
+    capacity_factor: float = 2.0
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, self_mask=None, train: bool = False):
+        from distributed_deep_learning_tpu.models.transformer import (
+            MultiHeadAttention)
+
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = MultiHeadAttention(self.num_heads, self.dtype,
+                               name="self_attn")(h, h, self_mask)
+        h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+        x = x + h
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = MoEMLP(self.num_experts, self.mlp_dim, self.capacity_factor,
+                   self.dtype, name="moe")(h, train=train)
+        h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+        return x + h

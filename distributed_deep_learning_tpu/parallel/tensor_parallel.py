@@ -1,0 +1,120 @@
+"""Tensor (Megatron-style) parallelism as sharding rules over a pjit mesh.
+
+Absent from the reference (SURVEY.md §2.5) but first-class here: on TPU,
+tensor parallelism is not a new execution engine, just a set of
+:class:`~jax.sharding.PartitionSpec` annotations on parameters and
+activations — XLA's SPMD partitioner inserts the all-reduce/all-gather
+dataflow Megatron hand-codes.  The classic recipe for a transformer block:
+
+* attention q/k/v projections — **column** parallel: shard the heads axis
+  over ``model`` (each device computes its heads end-to-end);
+* attention output projection — **row** parallel: shard the heads input
+  axis; XLA all-reduces the partial sums (one collective per block);
+* MLP up-projection — column parallel (shard ``mlp_dim``); gelu is local;
+* MLP down-projection — row parallel (shard ``mlp_dim`` input axis);
+* embedding table — shard the vocab axis (logits get a final all-reduce
+  via the weight-tied projection contraction).
+
+Rules are (path-regex → PartitionSpec) pairs matched against the flattened
+parameter path, most-specific-first; unmatched leaves stay replicated.
+Works for any model whose parameter names follow the package's transformer
+modules; write new rule tables for new families.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Rules = Sequence[tuple[str, P]]
+
+
+def transformer_tp_rules(axis: str = "model", fsdp_axis: str | None = None
+                         ) -> Rules:
+    """Megatron sharding for :mod:`..models.transformer` parameter names.
+
+    DenseGeneral kernels are (d_model, H, head_dim) for q/k/v and
+    (H, head_dim, d_model) for the out projection; MLP kernels are
+    (d_model, mlp_dim) / (mlp_dim, d_model); the tied embedding table is
+    (vocab, d_model).  ``fsdp_axis`` (optional) additionally shards the
+    replicated-with-respect-to-TP dimension ZeRO-3 style.
+    """
+    f = fsdp_axis
+    return (
+        # attention: column-parallel qkv (heads axis 1), row-parallel out
+        (r".*(self_attn|cross_attn)/(q|k|v)/kernel$", P(f, axis, None)),
+        (r".*(self_attn|cross_attn)/(q|k|v)/bias$", P(axis, None)),
+        (r".*(self_attn|cross_attn)/out/kernel$", P(axis, None, f)),
+        (r".*(self_attn|cross_attn)/out/bias$", P()),
+        # MLP: column-parallel up (Dense_0), row-parallel down (Dense_1)
+        (r"(^|.*/)Dense_0/kernel$", P(f, axis)),
+        (r"(^|.*/)Dense_0/bias$", P(axis)),
+        (r"(^|.*/)Dense_1/kernel$", P(axis, f)),
+        (r"(^|.*/)Dense_1/bias$", P()),
+        # embedding: vocab-sharded table
+        (r".*embed/tok/embedding$", P(axis, f)),
+    )
+
+
+def _match(path: str, rules: Rules) -> P:
+    for pattern, spec in rules:
+        if re.match(pattern, path):
+            return spec
+    return P()
+
+
+def param_specs(params: Any, rules: Rules) -> Any:
+    """Map a params pytree to a pytree of PartitionSpecs via `rules`.
+
+    Paths are '/'-joined flattened keys (Flax naming), e.g.
+    ``layers_0/self_attn/q/kernel``.
+    """
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def path_str(kp):
+        parts = []
+        for k in kp:
+            if hasattr(k, "key"):
+                parts.append(str(k.key))
+            elif hasattr(k, "idx"):
+                parts.append(str(k.idx))
+            else:
+                parts.append(str(k))
+        return "/".join(parts)
+
+    spec_map = {path_str(kp): _match(path_str(kp), rules) for kp, _ in flat}
+
+    def to_spec(kp, leaf):
+        return spec_map[path_str(kp)]
+
+    return jax.tree_util.tree_map_with_path(to_spec, params)
+
+
+def shard_params(params: Any, mesh: Mesh, rules: Rules) -> Any:
+    """Device-put `params` with the rule-derived shardings."""
+    specs = param_specs(params, rules)
+    return jax.device_put(
+        params, jax.tree.map(lambda s: NamedSharding(mesh, s), specs))
+
+
+def validate_divisibility(params: Any, mesh: Mesh, rules: Rules) -> None:
+    """Fail fast when a rule's axis doesn't divide the parameter dim."""
+    specs = param_specs(params, rules)
+
+    def check(leaf, spec):
+        for dim, names in enumerate(spec):
+            if names is None:
+                continue
+            for name in ([names] if isinstance(names, str) else names):
+                size = mesh.shape[name]
+                if np.shape(leaf)[dim] % size:
+                    raise ValueError(
+                        f"dim {dim} of shape {np.shape(leaf)} not divisible "
+                        f"by mesh axis {name}={size}")
+
+    jax.tree.map(check, params, specs,
+                 is_leaf=lambda x: isinstance(x, P))
