@@ -65,12 +65,17 @@ def _result(phase: str, epoch: int | None, totals, t0: float, t1: float) -> Epoc
 
 
 def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
-        test_loader, epochs: int, logger: PhaseLogger | None = None
+        test_loader, epochs: int, logger: PhaseLogger | None = None,
+        checkpointer=None, start_epoch: int = 1
         ) -> tuple[TrainState, list[EpochResult]]:
+    """Drive the epoch loop.  With a ``checkpointer``
+    (:class:`..utils.checkpoint.Checkpointer`) the state is saved after
+    every epoch (async) — pass ``start_epoch`` = last saved epoch + 1 to
+    resume a preempted run."""
     logger = logger or PhaseLogger(verbose=False)
     history: list[EpochResult] = []
 
-    for epoch in range(1, epochs + 1):  # reference counts epochs from 1
+    for epoch in range(start_epoch, epochs + 1):  # reference counts from 1
         train_loader.set_epoch(epoch)
         t0 = logger.phase_begin("train", epoch)
         state, totals = _run_phase(train_step, state, train_loader, train=True)
@@ -86,6 +91,12 @@ def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
         # reference prints only the validation end line (CNN/main.py:111)
         logger.phase_end("validation", epoch, accuracy=res.accuracy, loss=res.loss)
         history.append(res)
+
+        if checkpointer is not None:
+            checkpointer.save(epoch, state)
+
+    if checkpointer is not None:
+        checkpointer.wait_until_finished()
 
     t0 = logger.clock()
     _, totals = _run_phase(eval_step, state, test_loader, train=False)

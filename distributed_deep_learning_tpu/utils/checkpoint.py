@@ -1,0 +1,101 @@
+"""Checkpoint / resume on orbax, sharding-aware.
+
+The reference has NO checkpointing — no ``torch.save`` anywhere; every run
+is train-from-scratch (SURVEY.md §5).  A TPU framework can't ship without
+it: pod jobs get preempted, and elastic resume is the failure-recovery
+mechanism.  Because :class:`~..train.state.TrainState` is one pytree, a
+checkpoint is one atomic orbax save; restore takes an *abstract* target
+built from the live state, so arrays come back with the same shardings
+they were saved under (each host restores only its addressable shards —
+multi-host safe by construction).
+
+Only pytree leaves (step/params/model_state/opt_state) are persisted;
+``apply_fn``/``tx`` are code, re-supplied by the target state at restore.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+import orbax.checkpoint as ocp
+
+from distributed_deep_learning_tpu.train.state import TrainState
+
+# works for TrainState AND any state holder exposing these fields (e.g. the
+# staged trainer's StagedState)
+_FIELDS = ("step", "params", "model_state", "opt_state")
+
+
+def _as_pytree(state) -> dict:
+    return {f: getattr(state, f) for f in _FIELDS}
+
+
+def _with_fields(state, fields: dict):
+    if hasattr(state, "replace"):  # flax.struct dataclass
+        return state.replace(**fields)
+    return dataclasses.replace(state, **fields)
+
+
+class Checkpointer:
+    """Thin orbax CheckpointManager wrapper bound to one run directory."""
+
+    def __init__(self, directory: str | os.PathLike, *, keep: int = 3):
+        self._dir = os.path.abspath(os.fspath(directory))
+        os.makedirs(self._dir, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(max_to_keep=keep,
+                                                 create=True),
+        )
+
+    # -- save ---------------------------------------------------------------
+    def save(self, step: int, state: TrainState, *, force: bool = False,
+             wait: bool = False) -> bool:
+        """Persist `state` under `step`.  Async by default (the save runs
+        while training continues); `wait` blocks until durable."""
+        saved = self._mgr.save(
+            step, args=ocp.args.StandardSave(_as_pytree(state)), force=force)
+        if wait:
+            self._mgr.wait_until_finished()
+        return saved
+
+    # -- restore ------------------------------------------------------------
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, target: TrainState, step: int | None = None
+                ) -> TrainState | None:
+        """Restore into the structure/shardings of `target`.
+
+        Returns None when the directory holds no checkpoint (caller starts
+        fresh) — the preemption-resume idiom::
+
+            state = ckpt.restore(state) or state
+        """
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        # abstract target: arrays → ShapeDtypeStruct carrying their sharding
+        # (so each host restores its addressable shards); python scalars
+        # (e.g. a plain int step) pass through as-is
+        abstract = jax.tree.map(
+            lambda x: ocp.utils.to_shape_dtype_struct(x)
+            if isinstance(x, jax.Array) else x,
+            _as_pytree(target))
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract))
+        return _with_fields(target, restored)
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self) -> "Checkpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,153 @@
+"""Host-level failure detection: heartbeats + liveness monitor.
+
+The reference's only liveness coupling is a single trailing ``barrier()``
+(``CNN/main.py:183-184``) — any rank failure hangs the job with no
+diagnosis (SURVEY.md §5).  Within a jitted step, TPU collectives share that
+all-or-nothing fate; what a framework CAN do is detect the dead host fast,
+name it, and trigger checkpoint-resume instead of hanging a pod for hours.
+
+Mechanism: each process runs a :class:`Heartbeat` thread touching
+``<dir>/hb-<rank>`` every ``interval`` seconds (``dir`` on a filesystem all
+hosts see — the standard TPU-pod setup has shared GCS/NFS scratch).  Any
+process may call :func:`detect_failures` to list ranks whose beat is stale,
+or wrap a training loop in :class:`FailureMonitor` to raise
+:class:`WorkerFailure` promptly instead of waiting on a dead collective
+forever.  Recovery = restart the job and resume from the last orbax
+checkpoint (:mod:`.checkpoint`).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+class WorkerFailure(RuntimeError):
+    """Raised by FailureMonitor when peers stop heartbeating."""
+
+    def __init__(self, dead_ranks: list[int]):
+        self.dead_ranks = dead_ranks
+        super().__init__(f"worker(s) {dead_ranks} missed heartbeat deadline")
+
+
+def _hb_path(directory: str, rank: int) -> str:
+    return os.path.join(directory, f"hb-{rank}")
+
+
+class Heartbeat:
+    """Daemon thread stamping this process's liveness file."""
+
+    def __init__(self, directory: str, rank: int, interval: float = 5.0):
+        self.directory = os.fspath(directory)
+        self.rank = rank
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def beat_once(self) -> None:
+        os.makedirs(self.directory, exist_ok=True)
+        path = _hb_path(self.directory, self.rank)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(f"{time.time():f}\n")
+        os.replace(tmp, path)  # atomic on POSIX
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.beat_once()
+
+    def start(self) -> "Heartbeat":
+        self.beat_once()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"heartbeat-{self.rank}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval)
+
+    def __enter__(self) -> "Heartbeat":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def last_beat(directory: str, rank: int) -> float | None:
+    """Timestamp of `rank`'s most recent beat, None if it never beat."""
+    try:
+        with open(_hb_path(directory, rank)) as f:
+            return float(f.read().strip())
+    except (FileNotFoundError, ValueError):
+        return None
+
+
+def detect_failures(directory: str, world_size: int, timeout: float,
+                    now: float | None = None,
+                    grace_ranks: tuple[int, ...] = ()) -> list[int]:
+    """Ranks whose heartbeat is older than `timeout` (or absent)."""
+    now = time.time() if now is None else now
+    dead = []
+    for rank in range(world_size):
+        if rank in grace_ranks:
+            continue
+        beat = last_beat(directory, rank)
+        if beat is None or now - beat > timeout:
+            dead.append(rank)
+    return dead
+
+
+class FailureMonitor:
+    """Background watcher raising :class:`WorkerFailure` via a callback (or
+    recording it for polling) when any peer goes stale."""
+
+    def __init__(self, directory: str, world_size: int, *,
+                 timeout: float = 30.0, poll_interval: float = 5.0,
+                 self_rank: int | None = None):
+        self.directory = os.fspath(directory)
+        self.world_size = world_size
+        self.timeout = timeout
+        self.poll_interval = poll_interval
+        self.grace = (self_rank,) if self_rank is not None else ()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.failure: WorkerFailure | None = None
+
+    def check(self) -> None:
+        """Raise immediately if any peer is stale (poll-style use)."""
+        dead = detect_failures(self.directory, self.world_size, self.timeout,
+                               grace_ranks=self.grace)
+        if dead:
+            raise WorkerFailure(dead)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.check()
+            except WorkerFailure as e:  # record; training thread polls
+                self.failure = e
+                return
+
+    def start(self) -> "FailureMonitor":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="failure-monitor")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.poll_interval)
+
+    def raise_if_failed(self) -> None:
+        if self.failure is not None:
+            raise self.failure
+
+    def __enter__(self) -> "FailureMonitor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -226,6 +226,17 @@ class StagedTrainer:
         return prediction_metrics(pred, y, self.loss_fn(pred, y))
 
 
+def _maybe_checkpointer(config: Config):
+    """(checkpointer, start_epoch) from config; (None, 1) when disabled."""
+    if not config.checkpoint_dir:
+        return None, 1
+    from distributed_deep_learning_tpu.utils.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(config.checkpoint_dir)
+    last = ckpt.latest_step() if config.resume else None
+    return ckpt, (last + 1 if last is not None else 1)
+
+
 # ---------------------------------------------------------------------------
 # The runner
 # ---------------------------------------------------------------------------
@@ -281,8 +292,17 @@ def run_workload(spec: WorkloadSpec, config: Config
         state = create_train_state(model, rng, example, tx)
         state = place_state(state, mesh)
         train_step, eval_step = make_step_fns(mesh, loss_fn)
-        return fit(state, train_step, eval_step, *loaders,
-                   epochs=config.epochs, logger=logger)
+        ckpt, start_epoch = _maybe_checkpointer(config)
+        if ckpt is not None and start_epoch > 1:
+            state = ckpt.restore(state) or state
+            logger.info(f"resumed from epoch {start_epoch - 1}")
+        try:
+            return fit(state, train_step, eval_step, *loaders,
+                       epochs=config.epochs, logger=logger,
+                       checkpointer=ckpt, start_epoch=start_epoch)
+        finally:
+            if ckpt is not None:
+                ckpt.close()
 
     # model / pipeline: staged MPMD over explicit devices
     layers = list(spec.build_layers(config, dataset))
