@@ -1,0 +1,3 @@
+"""Custom TPU kernels (Pallas) for ops where fused hand-written kernels
+beat XLA's default lowering — the TPU-native counterpart of the CUDA/Triton
+kernels the reference delegates to (SURVEY.md §2.4)."""

@@ -1,0 +1,169 @@
+"""Fused (flash) attention as a Pallas TPU kernel.
+
+The reference leans on cuDNN/Triton for its fused kernels
+(``torch.compile``, ``WrapperTriton``, SURVEY.md §2.4); the TPU-native
+counterpart is a Pallas kernel.  Attention is *the* op worth fusing: naive
+attention materialises the (T×T) score matrix in HBM, while this kernel
+streams K/V blocks through VMEM and keeps the online-softmax running
+statistics (max ``m``, denominator ``l``, accumulator ``acc``) in
+registers — O(T·D) memory, MXU-shaped contractions, no HBM round-trip for
+the scores.
+
+Grid: one program per (batch·head, query-block); each program loops over
+key blocks with ``fori_loop`` (static trip count, causal handled by
+masking — uniform control flow, nothing data-dependent).
+
+Backward: ``jax.custom_vjp`` with a rematerialising dense backward (the
+standard first rung of the flash-attention ladder — forward never pays the
+O(T²) HBM cost; backward recomputes scores blockwise in plain XLA, which
+fuses well).  On non-TPU platforms the kernel runs in interpreter mode so
+the same code path is testable on the CPU mesh.
+
+The same online-softmax recurrence drives :mod:`..parallel.ring_attention`
+at the inter-chip level — this kernel is the intra-chip member of that
+family.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
+                causal: bool, block_k: int, seq_len: int):
+    q = q_ref[0].astype(jnp.float32)                 # (bq, D)
+    bq, d = q.shape
+    q_off = pl.program_id(1) * bq
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = q_off + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        blk_max = jnp.max(s, axis=-1, keepdims=True)
+        new_m = jnp.maximum(m, blk_max)
+        corr = jnp.exp(m - new_m)
+        p = jnp.exp(s - new_m)
+        new_l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        new_acc = acc * corr + jnp.dot(p, v,
+                                       preferred_element_type=jnp.float32)
+        return new_m, new_l, new_acc
+
+    n_blocks = seq_len // block_k
+    _, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    BH, T, D = q.shape
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if T % block_q or T % block_k:
+        raise ValueError(f"seq len {T} must divide block sizes "
+                         f"({block_q}, {block_k})")
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                               block_k=block_k, seq_len=T)
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, qi: (b, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T, D), lambda b, qi: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T, D), lambda b, qi: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, qi: (b, qi, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _dense_attention_bhtd(q, k, v, sm_scale, causal):
+    """(BH, T, D) dense reference used for the rematerialised backward."""
+    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None], s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bqk,bkd->bqd", w, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhtd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+
+
+def _flash_vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: _dense_attention_bhtd(q, k, v, sm_scale, causal),
+        q, k, v)
+    return vjp(g)
+
+
+_flash_bhtd.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                    causal: bool = False, sm_scale: float | None = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool | None = None) -> jnp.ndarray:
+    """Fused attention on ``(B, T, H, D)`` q/k/v (same layout as
+    :func:`..models.transformer.dot_product_attention`).
+
+    ``interpret=None`` auto-selects: compiled on TPU, interpreter elsewhere
+    (so CPU tests exercise the identical kernel code).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    B, T, H, D = q.shape
+
+    def to_bhtd(x):
+        return jnp.swapaxes(x, 1, 2).reshape(B * H, T, D)
+
+    out = _flash_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v), sm_scale, causal,
+                      block_q, block_k, interpret)
+    return jnp.swapaxes(out.reshape(B, H, T, D), 1, 2)
+
+
+def make_attention_fn(causal: bool = False, **kw):
+    """Adapter: flash attention as a ``MultiHeadAttention.attention_fn``
+    (mirrors :func:`..parallel.ring_attention.make_attention_fn`)."""
+
+    def attn(q, k, v, *, mask=None, dtype=jnp.float32):
+        if mask is not None:
+            raise NotImplementedError(
+                "flash_attention computes its causal mask in-kernel; "
+                "explicit mask tensors are unsupported (pad-free batches or "
+                "the dense path instead)")
+        return flash_attention(q, k, v, causal=causal, **kw).astype(dtype)
+
+    return attn
