@@ -28,8 +28,14 @@ class ArrayDataset:
         return len(self.features)
 
     def batch(self, indices: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-        """Gather one batched (x, y) pair — the only hot-path data op."""
-        return self.features[indices], self.targets[indices]
+        """Gather one batched (x, y) pair — the only hot-path data op.
+        Uses the native C++ row gather when available
+        (:mod:`..native`), NumPy fancy indexing otherwise."""
+        from distributed_deep_learning_tpu import native
+
+        indices = np.asarray(indices)
+        return native.take(self.features, indices), \
+            native.take(self.targets, indices)
 
 
 # ---------------------------------------------------------------------------

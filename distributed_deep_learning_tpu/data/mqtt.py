@@ -26,8 +26,10 @@ def load_mqtt(path: str = "/data/MQTT/dataset.csv") -> ArrayDataset:
         raise FileNotFoundError(
             f"{path} not found — use data.datasets.synthetic_mqtt for the "
             "shape-compatible synthetic twin")
-    import pandas as pd
+    from distributed_deep_learning_tpu import native
 
-    frame = pd.read_csv(path, low_memory=False)
-    data = frame.values[:, 1:].astype(np.float32)  # drop index column
-    return ArrayDataset(data[:, :-NUM_TARGETS], data[:, -NUM_TARGETS:])
+    # native C++ parser (multi-threaded; pandas replaced per SURVEY §2.4);
+    # drop the index column like the reference
+    data = native.read_csv(path, skip_header=True, drop_first_col=True)
+    return ArrayDataset(np.ascontiguousarray(data[:, :-NUM_TARGETS]),
+                        np.ascontiguousarray(data[:, -NUM_TARGETS:]))

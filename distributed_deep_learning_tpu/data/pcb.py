@@ -121,18 +121,21 @@ class PCBDataset:
 
     def _crop_resize(self, img: np.ndarray, top: int, left: int,
                      height: int, width: int) -> np.ndarray:
-        """Zero-padded crop then bilinear resize (reference ``resized_crop``)."""
-        from PIL import Image
+        """Zero-padded crop then bilinear resize (reference ``resized_crop``
+        semantics); the resize runs in the native C++ library
+        (:func:`..native.crop_resize_bilinear`, align_corners=False) rather
+        than PIL — same convention as torchvision's functional resize."""
+        from distributed_deep_learning_tpu import native
 
         h, w = img.shape[:2]
-        out = np.zeros((max(height, 1), max(width, 1), 3), dtype=np.uint8)
+        height, width = max(height, 1), max(width, 1)
+        out = np.zeros((height, width, 3), dtype=np.float32)
         y0, y1 = max(top, 0), min(top + height, h)
         x0, x1 = max(left, 0), min(left + width, w)
         if y1 > y0 and x1 > x0:
             out[y0 - top:y1 - top, x0 - left:x1 - left] = img[y0:y1, x0:x1]
-        resized = Image.fromarray(out).resize(
-            (self.image_size, self.image_size), Image.BILINEAR)
-        return np.asarray(resized, dtype=np.float32)
+        return native.crop_resize_bilinear(out, 0, 0, height, width,
+                                           self.image_size, self.image_size)
 
     def item(self, index: int) -> tuple[np.ndarray, np.ndarray]:
         path, (xmin, ymin, xmax, ymax), target = self.samples[index >> 1]

@@ -57,10 +57,12 @@ class PdMWindowedDataset:
         return base + (idx - machine * self.div)
 
     def batch(self, indices: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        from distributed_deep_learning_tpu import native
+
         pos = self.idx2pos(np.asarray(indices))
-        rows = pos[:, None] + self._offsets            # (B, history)
-        x = self.features[rows]                        # (B, history, F)
-        y = self.targets[pos - self.history]           # first window row (Q5)
+        # windows ending at pos (inclusive), via the native C++ gather
+        x = native.window_gather(self.features, pos, self.history + 1)
+        y = native.take(self.targets, pos - self.history)  # first row (Q5)
         return x, y
 
 
@@ -72,10 +74,10 @@ def load_pdm(path: str = "/data/PredictiveMaintenance/dataset.csv",
         raise FileNotFoundError(
             f"{path} not found — use data.datasets.synthetic_pdm for the "
             "shape-compatible synthetic twin")
-    import pandas as pd
+    from distributed_deep_learning_tpu import native
 
-    frame = pd.read_csv(path, low_memory=False, dtype="float32")
-    data = frame.values
-    return PdMWindowedDataset(data[:, :-NUM_TARGETS], data[:, -NUM_TARGETS:],
-                              history=history,
-                              instances_per_machine=instances_per_machine)
+    data = native.read_csv(path, skip_header=True)
+    return PdMWindowedDataset(
+        np.ascontiguousarray(data[:, :-NUM_TARGETS]),
+        np.ascontiguousarray(data[:, -NUM_TARGETS:]),
+        history=history, instances_per_machine=instances_per_machine)

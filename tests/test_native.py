@@ -1,0 +1,122 @@
+"""Native C++ data library vs its NumPy fallbacks (identical semantics)."""
+
+import numpy as np
+import pytest
+
+from distributed_deep_learning_tpu import native
+
+
+@pytest.fixture(scope="module")
+def lib_available():
+    if not native.available():
+        pytest.skip("native library could not be built (no g++?)")
+    return True
+
+
+def test_build_succeeds(lib_available):
+    assert native.get_lib() is not None
+
+
+def test_gather_rows_matches_numpy(lib_available):
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((1000, 37), dtype=np.float32)
+    idx = rng.integers(0, 1000, size=256)
+    np.testing.assert_array_equal(native.gather_rows(data, idx), data[idx])
+
+
+def test_take_nd(lib_available):
+    rng = np.random.default_rng(1)
+    imgs = rng.standard_normal((50, 8, 8, 3), dtype=np.float32)
+    idx = rng.integers(0, 50, size=16)
+    np.testing.assert_array_equal(native.take(imgs, idx), imgs[idx])
+
+
+def test_window_gather_matches_numpy(lib_available):
+    rng = np.random.default_rng(2)
+    data = rng.standard_normal((500, 12), dtype=np.float32)
+    pos = rng.integers(9, 500, size=64)
+    got = native.window_gather(data, pos, history=10)
+    offsets = np.arange(-9, 1)
+    expected = data[pos[:, None] + offsets]
+    np.testing.assert_array_equal(got, expected)
+    assert got.shape == (64, 10, 12)
+
+
+def test_csv_roundtrip(tmp_path, lib_available):
+    rng = np.random.default_rng(3)
+    data = rng.standard_normal((200, 7)).astype(np.float32)
+    path = tmp_path / "t.csv"
+    header = ",".join(f"c{i}" for i in range(7))
+    np.savetxt(path, data, delimiter=",", header=header, comments="",
+               fmt="%.9g")
+    got = native.read_csv(str(path), skip_header=True)
+    np.testing.assert_allclose(got, data, rtol=1e-6)
+
+
+def test_csv_drop_first_col(tmp_path, lib_available):
+    data = np.arange(12, dtype=np.float32).reshape(4, 3)
+    path = tmp_path / "d.csv"
+    np.savetxt(path, data, delimiter=",", header="a,b,c", comments="",
+               fmt="%.9g")
+    got = native.read_csv(str(path), skip_header=True, drop_first_col=True)
+    np.testing.assert_allclose(got, data[:, 1:])
+
+
+def test_csv_missing_file_raises(lib_available):
+    with pytest.raises(FileNotFoundError):
+        native.read_csv("/nonexistent/file.csv")
+
+
+def test_crop_resize_matches_numpy_fallback(lib_available):
+    rng = np.random.default_rng(4)
+    img = rng.standard_normal((48, 40, 3)).astype(np.float32)
+    got = native.crop_resize_bilinear(img, 4, 6, 32, 24, 16, 16)
+    expected = native._crop_resize_numpy(img, 4, 6, 32, 24, 16, 16)
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-5)
+    assert got.shape == (16, 16, 3)
+
+
+def test_crop_resize_identity(lib_available):
+    rng = np.random.default_rng(5)
+    img = rng.standard_normal((16, 16, 3)).astype(np.float32)
+    got = native.crop_resize_bilinear(img, 0, 0, 16, 16, 16, 16)
+    np.testing.assert_allclose(got, img, rtol=1e-6, atol=1e-6)
+
+
+def test_dataset_batch_uses_native(lib_available):
+    from distributed_deep_learning_tpu.data.datasets import synthetic_mqtt
+
+    ds = synthetic_mqtt(256)
+    idx = np.arange(0, 64)
+    x, y = ds.batch(idx)
+    np.testing.assert_array_equal(x, ds.features[idx])
+    np.testing.assert_array_equal(y, ds.targets[idx])
+
+
+def test_pdm_windows_native_vs_fallback(monkeypatch):
+    from distributed_deep_learning_tpu.data.datasets import synthetic_pdm
+
+    ds = synthetic_pdm(512)
+    idx = np.arange(0, 128, 3)
+    x_native, y_native = ds.batch(idx)
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)  # force fallback path
+    x_np, y_np = ds.batch(idx)
+    np.testing.assert_array_equal(x_native, x_np)
+    np.testing.assert_array_equal(y_native, y_np)
+
+
+def test_prefetch_loader_yields_same_batches(mesh8):
+    from distributed_deep_learning_tpu.data.datasets import synthetic_mqtt
+    from distributed_deep_learning_tpu.data.loader import (DeviceLoader,
+                                                           PrefetchLoader)
+
+    ds = synthetic_mqtt(512)
+    base = DeviceLoader(ds, np.arange(256), 64, mesh8, shuffle=True, seed=3)
+    direct = [(np.asarray(x), np.asarray(y)) for x, y in base]
+    prefetched = [(np.asarray(x), np.asarray(y))
+                  for x, y in PrefetchLoader(base)]
+    assert len(direct) == len(prefetched) == 4
+    for (x1, y1), (x2, y2) in zip(direct, prefetched):
+        np.testing.assert_array_equal(x1, x2)
+        np.testing.assert_array_equal(y1, y2)
