@@ -1,0 +1,119 @@
+"""Profiling and compiler diagnostics.
+
+The reference's observability is wall-clock print lines plus
+``torch._dynamo.explain`` graph-break dumps (``CNN/model.py:289``,
+SURVEY.md §5).  The TPU-native equivalents are strictly stronger and live
+here:
+
+* :func:`trace` — ``jax.profiler`` device traces (TensorBoard/XProf
+  format): per-op device timelines, HBM usage, ICI collectives.
+* :func:`annotate` — named host-side regions that show up in the trace.
+* :func:`hlo_text` / :func:`compiled_text` — the compiler's view of a
+  jitted function before/after XLA optimisation (the ``dynamo.explain``
+  analogue; there are no "graph breaks" to hunt — if it traced, it's one
+  program — but fusion/layout decisions live in the optimised HLO).
+* :func:`cost_analysis` — XLA's FLOP/byte estimates for a jitted call.
+* :class:`StepTimer` — steps/sec / examples/sec meter with warmup skip.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Callable, Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None) -> Iterator[None]:
+    """Capture a device trace under ``log_dir`` (no-op when None) —
+    view with TensorBoard's profile plugin or xprof."""
+    if not log_dir:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region context manager; nests and appears on the trace
+    timeline (host track)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def _lowered(fn: Callable, *args, **kwargs):
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    return jitted.lower(*args, **kwargs)
+
+
+def hlo_text(fn: Callable, *args, **kwargs) -> str:
+    """StableHLO for `fn` at these abstract shapes (pre-optimisation)."""
+    return _lowered(fn, *args, **kwargs).as_text()
+
+
+def compiled_text(fn: Callable, *args, **kwargs) -> str:
+    """Post-XLA-optimisation HLO — where fusion and layout decisions are
+    visible (the thing to read when perf surprises)."""
+    return _lowered(fn, *args, **kwargs).compile().as_text()
+
+
+def cost_analysis(fn: Callable, *args, **kwargs) -> dict[str, Any]:
+    """XLA's cost model for one call: flops, bytes accessed, etc."""
+    analysis = _lowered(fn, *args, **kwargs).compile().cost_analysis()
+    if isinstance(analysis, (list, tuple)):  # some backends wrap in a list
+        analysis = analysis[0] if analysis else {}
+    return dict(analysis) if analysis else {}
+
+
+class StepTimer:
+    """Steps/sec + examples/sec with compile-step warmup exclusion.
+
+    ``tick(examples)`` after each step; the first `warmup` ticks (compile,
+    cache population) are excluded from rates.  Rates use a device sync at
+    read time (`summary`) so async dispatch doesn't flatter the numbers.
+    """
+
+    def __init__(self, warmup: int = 1, clock=time.perf_counter):
+        self.warmup = warmup
+        self.clock = clock
+        self.reset()
+
+    def reset(self) -> None:
+        self._ticks = 0
+        self._examples = 0
+        self._t0: float | None = None
+        self._last: float | None = None
+
+    def tick(self, examples: int = 0) -> None:
+        now = self.clock()
+        self._ticks += 1
+        if self._ticks == self.warmup:
+            self._t0 = now
+            self._examples = 0
+        elif self._ticks > self.warmup:
+            self._examples += examples
+        self._last = now
+
+    @property
+    def measured_steps(self) -> int:
+        return max(0, self._ticks - self.warmup)
+
+    def summary(self, sync: Any = None) -> dict[str, float]:
+        """Rates over the post-warmup window.  Pass a jax.Array as `sync`
+        to block on it first (honest step timing)."""
+        if sync is not None:
+            jax.block_until_ready(sync)
+            self._last = self.clock()
+        if self._t0 is None or self._last is None or self.measured_steps == 0:
+            return {"steps_per_sec": 0.0, "examples_per_sec": 0.0,
+                    "seconds": 0.0}
+        dt = max(self._last - self._t0, 1e-9)
+        return {
+            "steps_per_sec": self.measured_steps / dt,
+            "examples_per_sec": self._examples / dt,
+            "seconds": dt,
+        }

@@ -44,6 +44,7 @@ from distributed_deep_learning_tpu.train.loop import EpochResult, fit
 from distributed_deep_learning_tpu.train.objectives import prediction_metrics
 from distributed_deep_learning_tpu.train.state import create_train_state
 from distributed_deep_learning_tpu.train.step import make_step_fns, place_state
+from distributed_deep_learning_tpu.utils import profiling
 from distributed_deep_learning_tpu.utils.config import Config, Device, Mode
 from distributed_deep_learning_tpu.utils.logging import PhaseLogger
 
@@ -297,9 +298,10 @@ def run_workload(spec: WorkloadSpec, config: Config
             state = ckpt.restore(state) or state
             logger.info(f"resumed from epoch {start_epoch - 1}")
         try:
-            return fit(state, train_step, eval_step, *loaders,
-                       epochs=config.epochs, logger=logger,
-                       checkpointer=ckpt, start_epoch=start_epoch)
+            with profiling.trace(config.profile_dir):
+                return fit(state, train_step, eval_step, *loaders,
+                           epochs=config.epochs, logger=logger,
+                           checkpointer=ckpt, start_epoch=start_epoch)
         finally:
             if ckpt is not None:
                 ckpt.close()
@@ -320,5 +322,6 @@ def run_workload(spec: WorkloadSpec, config: Config
     mesh = build_mesh({"data": 1}, stage_devices[:1])
     loaders = make_loaders(dataset, splits, config.batch_size, mesh,
                            seed=config.seed)
-    return fit(state, trainer.train_step, trainer.eval_step, *loaders,
-               epochs=config.epochs, logger=logger)
+    with profiling.trace(config.profile_dir):
+        return fit(state, trainer.train_step, trainer.eval_step, *loaders,
+                   epochs=config.epochs, logger=logger)
