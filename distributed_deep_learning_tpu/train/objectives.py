@@ -39,6 +39,18 @@ def l1_loss(pred: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
     return jnp.mean(jnp.abs(pred - targets))
 
 
+def token_cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray
+                        ) -> jnp.ndarray:
+    """Mean CE over non-pad token positions: ``logits`` (..., T, V) vs
+    integer ids ``targets`` (..., T) where id 0 is pad/ignored — the loss
+    convention for the seq2seq and MLM north-star workloads (matching
+    :func:`prediction_metrics`' pad exclusion)."""
+    valid = (targets != 0).astype(jnp.float32)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(
+        logits, jnp.maximum(targets, 0))
+    return jnp.sum(per_tok * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
 def argmax_correct(pred: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
     """Count of argmax matches in the batch (reference accuracy numerator).
 

@@ -133,6 +133,7 @@ class Config:
     mesh_shape: dict[str, int] | None = None  # explicit mesh, e.g. {"data":4,"stage":2}
     double_softmax: bool = False        # reference quirk Q4 (Softmax + CE); off → logits+CE
     sync_in_local_data_mode: bool = True  # reference quirk Q1 fixed by default
+    zero: str = "none"                  # optimizer/param sharding: none|1|fsdp
     checkpoint_dir: str | None = None
     resume: bool = False
     profile_dir: str | None = None
@@ -154,6 +155,10 @@ WORKLOAD_DEFAULTS: dict[str, dict[str, int]] = {
     "cnn": {"nlayers": 2, "size": 4},
     "lstm": {"nlayers": 1, "size": 128},
     "mlp": {"nlayers": 1, "size": 38},
+    # north-star families (BASELINE.json): -s is depth (resnet) / width
+    "resnet": {"nlayers": 4, "size": 18},
+    "transformer": {"nlayers": 6, "size": 512},
+    "bert": {"nlayers": 12, "size": 768},
 }
 
 
@@ -201,6 +206,9 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
     p.add_argument("--no-sync", dest="sync", action="store_false",
                    help="replicate reference quirk Q1 (local data mode trains "
                         "independent replicas)")
+    p.add_argument("--zero", choices=["none", "1", "fsdp"], default="none",
+                   help="shard optimizer state (ZeRO-1) or params+optimizer "
+                        "(fsdp) over the fsdp/data mesh axes")
     p.add_argument("--checkpoint-dir", type=str, default=None)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--profile-dir", type=str, default=None)
@@ -240,6 +248,7 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         mesh_shape=parse_mesh_arg(args.mesh),
         double_softmax=args.double_softmax,
         sync_in_local_data_mode=args.sync,
+        zero=args.zero,
         checkpoint_dir=args.checkpoint_dir,
         resume=args.resume,
         profile_dir=args.profile_dir,

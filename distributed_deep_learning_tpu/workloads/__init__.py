@@ -14,9 +14,13 @@ def get_spec(name: str):
         from distributed_deep_learning_tpu.workloads.cnn import SPEC
     elif name == "lstm":
         from distributed_deep_learning_tpu.workloads.lstm import SPEC
+    elif name in ("resnet", "transformer", "bert"):
+        from distributed_deep_learning_tpu.workloads.northstar import SPECS
+        return SPECS[name]
     else:
-        raise ValueError(f"unknown workload {name!r}; choose mlp|cnn|lstm")
+        raise ValueError(f"unknown workload {name!r}; choose one of "
+                         f"{'|'.join(WORKLOADS)}")
     return SPEC
 
 
-WORKLOADS = ("mlp", "cnn", "lstm")
+WORKLOADS = ("mlp", "cnn", "lstm", "resnet", "transformer", "bert")

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax.sharding import PartitionSpec as P
 
 from distributed_deep_learning_tpu.data.datasets import ArrayDataset
 from distributed_deep_learning_tpu.data.loader import make_loaders
@@ -291,8 +292,18 @@ def run_workload(spec: WorkloadSpec, config: Config
                                seed=config.seed)
         model = spec.build_model(config, dataset)
         state = create_train_state(model, rng, example, tx)
-        state = place_state(state, mesh)
-        train_step, eval_step = make_step_fns(mesh, loss_fn)
+        state_spec = P()
+        if config.zero != "none":
+            from distributed_deep_learning_tpu.parallel.zero import (
+                fsdp_state_spec, zero1_state_spec)
+
+            axis = "fsdp" if mesh.shape.get("fsdp", 1) > 1 else "data"
+            make_spec = zero1_state_spec if config.zero == "1" \
+                else fsdp_state_spec
+            state_spec = make_spec(state, mesh, axis=axis)
+        state = place_state(state, mesh, state_spec)
+        train_step, eval_step = make_step_fns(mesh, loss_fn,
+                                              state_spec=state_spec)
         ckpt, start_epoch = _maybe_checkpointer(config)
         if ckpt is not None and start_epoch > 1:
             state = ckpt.restore(state) or state

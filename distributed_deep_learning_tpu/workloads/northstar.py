@@ -1,0 +1,166 @@
+"""North-star workloads behind the same CLI: resnet, transformer, bert.
+
+These are the BASELINE.json configs (MNIST/CIFAR/ImageNet CNNs, WMT
+seq2seq, C4 MLM) — scope beyond the reference, exposed exactly like its
+workloads so one command line covers the whole model zoo::
+
+    python -m distributed_deep_learning_tpu resnet -s 18 -e 5 -b 256 -m data
+    python -m distributed_deep_learning_tpu transformer -l 6 -s 512 --zero 1
+    python -m distributed_deep_learning_tpu bert -l 12 -s 768 --dtype bfloat16
+
+Flag mapping: ``-l`` = layer count (transformer/bert), ``-s`` = ResNet
+depth (18/34/50) or model width.  All run on synthetic shape-twins of the
+real datasets (``data.datasets``); the loaders' contract means pointing
+them at real data is a dataset-constructor swap.
+
+Model/pipeline (staged) modes are intentionally not offered here: these
+models parallelise better with the sharded-step paths (``-m data`` +
+``--zero`` + ``--mesh``), and their trunks pipeline via
+:func:`..parallel.spmd_pipeline.spmd_pipeline` (see
+``tests/test_pipeline_transformer.py``) rather than MPMD staging.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_deep_learning_tpu.data.datasets import (ArrayDataset,
+                                                         synthetic_c4_mlm,
+                                                         synthetic_cifar10,
+                                                         synthetic_wmt)
+from distributed_deep_learning_tpu.models.resnet import (BasicBlock,
+                                                         BottleneckBlock,
+                                                         ResNet)
+from distributed_deep_learning_tpu.models.transformer import (BertEncoder,
+                                                              TransformerSeq2Seq)
+from distributed_deep_learning_tpu.train.objectives import (
+    cross_entropy_loss, token_cross_entropy)
+from distributed_deep_learning_tpu.utils.config import Config, parse_args
+from distributed_deep_learning_tpu.workloads.base import (WorkloadSpec,
+                                                          config_dtype,
+                                                          example_from_dataset,
+                                                          run_workload)
+
+_RESNET_LAYERS = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3), 50: (3, 4, 6, 3)}
+
+
+def _no_staging(config, dataset):
+    raise NotImplementedError(
+        "model/pipeline staging is not offered for north-star workloads; "
+        "use -m data with --zero/--mesh (or the SPMD pipeline API directly)")
+
+
+# --- resnet ----------------------------------------------------------------
+
+def _resnet_model(config: Config, dataset):
+    depth = config.size if config.size in _RESNET_LAYERS else 18
+    return ResNet(stage_sizes=_RESNET_LAYERS[depth],
+                  block_cls=BottleneckBlock if depth >= 50 else BasicBlock,
+                  num_classes=10, small_inputs=True,
+                  dtype=config_dtype(config))
+
+
+RESNET_SPEC = WorkloadSpec(
+    name="resnet",
+    build_dataset=lambda c: synthetic_cifar10(seed=c.seed),
+    build_model=_resnet_model,
+    build_layers=_no_staging,
+    partitioner=lambda n, s: np.zeros(n, np.int64),
+    build_loss=lambda c: cross_entropy_loss,
+    build_optimizer=lambda c, steps: optax.sgd(
+        c.learning_rate if c.learning_rate != 1e-3 else 0.1, momentum=0.9),
+    example_input=example_from_dataset,
+)
+
+
+# --- transformer (WMT seq2seq) --------------------------------------------
+
+class Seq2SeqAdapter(nn.Module):
+    """Adapts ``TransformerSeq2Seq``'s batch-dict interface to the runner's
+    ``model(x, train)`` convention: ``x`` is source and target token ids
+    concatenated along the sequence axis (``src_len`` is static)."""
+
+    model: TransformerSeq2Seq
+    src_len: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        batch = {"inputs": x[:, :self.src_len],
+                 "targets": x[:, self.src_len:]}
+        return self.model(batch, train=train)
+
+
+def _wmt_dataset(config: Config, src_len: int = 32, tgt_len: int = 32,
+                 vocab: int = 1024):
+    ds = synthetic_wmt(src_len=src_len, tgt_len=tgt_len, vocab_size=vocab,
+                       seed=config.seed)
+    feats = np.concatenate([ds.features, ds.targets], axis=1)
+    return ArrayDataset(feats, ds.targets)
+
+
+def _transformer_model(config: Config, dataset):
+    d = config.size
+    # dropout_rate=0: the shared runner drives models without PRNG threading
+    # (deterministic steps, the reference's seed-42 contract); pass explicit
+    # rngs to model.apply for stochastic training outside the runner
+    inner = TransformerSeq2Seq(
+        vocab_size=1024, num_layers=config.num_layers, d_model=d,
+        num_heads=max(2, d // 64), mlp_dim=4 * d, dropout_rate=0.0,
+        dtype=config_dtype(config))
+    src_len = dataset.features.shape[1] - dataset.targets.shape[1]
+    return Seq2SeqAdapter(inner, src_len)
+
+
+TRANSFORMER_SPEC = WorkloadSpec(
+    name="transformer",
+    build_dataset=_wmt_dataset,
+    build_model=_transformer_model,
+    build_layers=_no_staging,
+    partitioner=lambda n, s: np.zeros(n, np.int64),
+    build_loss=lambda c: token_cross_entropy,
+    build_optimizer=lambda c, steps: optax.adamw(c.learning_rate),
+    example_input=lambda c, ds: jnp.zeros((1, ds.features.shape[1]),
+                                          jnp.int32),
+)
+
+
+# --- bert (C4 MLM) ---------------------------------------------------------
+
+def _mlm_dataset(config: Config, vocab: int = 1024, mask_id: int = 103):
+    ds = synthetic_c4_mlm(vocab_size=vocab, mask_id=mask_id, seed=config.seed)
+    # loss/metric sites are exactly the masked positions: keep the original
+    # id there and 0 (= ignore) everywhere else, matching the pad-exclusion
+    # convention of token_cross_entropy / prediction_metrics
+    targets = np.where(ds.features == mask_id, ds.targets, 0)
+    return ArrayDataset(ds.features, targets.astype(np.int32))
+
+
+def _bert_model(config: Config, dataset):
+    d = config.size
+    return BertEncoder(vocab_size=1024, num_layers=config.num_layers,
+                       d_model=d, num_heads=max(2, d // 64), mlp_dim=4 * d,
+                       dropout_rate=0.0, dtype=config_dtype(config))
+
+
+BERT_SPEC = WorkloadSpec(
+    name="bert",
+    build_dataset=_mlm_dataset,
+    build_model=_bert_model,
+    build_layers=_no_staging,
+    partitioner=lambda n, s: np.zeros(n, np.int64),
+    build_loss=lambda c: token_cross_entropy,
+    build_optimizer=lambda c, steps: optax.adamw(c.learning_rate),
+    example_input=lambda c, ds: jnp.zeros((1, ds.features.shape[1]),
+                                          jnp.int32),
+)
+
+SPECS = {"resnet": RESNET_SPEC, "transformer": TRANSFORMER_SPEC,
+         "bert": BERT_SPEC}
+
+
+def main(argv=None, workload: str = "resnet"):
+    config = parse_args(argv, workload=workload)
+    return run_workload(SPECS[workload], config)
