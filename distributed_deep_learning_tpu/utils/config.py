@@ -134,6 +134,7 @@ class Config:
     double_softmax: bool = False        # reference quirk Q4 (Softmax + CE); off → logits+CE
     sync_in_local_data_mode: bool = True  # reference quirk Q1 fixed by default
     zero: str = "none"                  # optimizer/param sharding: none|1|fsdp
+    grad_accum: int = 1                 # gradient-accumulation microsteps
     checkpoint_dir: str | None = None
     resume: bool = False
     profile_dir: str | None = None
@@ -206,6 +207,9 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
     p.add_argument("--no-sync", dest="sync", action="store_false",
                    help="replicate reference quirk Q1 (local data mode trains "
                         "independent replicas)")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="split each batch into this many sequential "
+                        "microbatches, accumulating gradients")
     p.add_argument("--zero", choices=["none", "1", "fsdp"], default="none",
                    help="shard optimizer state (ZeRO-1) or params+optimizer "
                         "(fsdp) over the fsdp/data mesh axes")
@@ -249,6 +253,7 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         double_softmax=args.double_softmax,
         sync_in_local_data_mode=args.sync,
         zero=args.zero,
+        grad_accum=args.grad_accum,
         checkpoint_dir=args.checkpoint_dir,
         resume=args.resume,
         profile_dir=args.profile_dir,

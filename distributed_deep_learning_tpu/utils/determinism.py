@@ -1,0 +1,62 @@
+"""Determinism checking: the reference's seed-42 contract, executable.
+
+The reference substitutes determinism for race detection — fixed seed,
+seeded samplers, ``shuffle=False`` (SURVEY.md §5) — but never *checks* it;
+a nondeterministic op or a host-side race would silently break run
+comparability.  :func:`check_step_determinism` makes the contract
+testable: run the same step twice from the same state/batch and diff every
+output leaf bit-for-bit (XLA:TPU is deterministic given deterministic
+inputs, so any mismatch is a real bug — an unseeded RNG, a host race, a
+non-deterministic reduction on the host side).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+
+class NondeterminismError(AssertionError):
+    def __init__(self, paths: list[str]):
+        self.paths = paths
+        super().__init__(
+            f"step produced different results on identical inputs at: "
+            f"{paths[:10]}{'...' if len(paths) > 10 else ''}")
+
+
+def _leaf_paths(tree: Any) -> list[tuple[str, Any]]:
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [("/".join(str(getattr(k, 'key', getattr(k, 'idx', k)))
+                      for k in kp), leaf) for kp, leaf in flat]
+
+
+def diff_trees(a: Any, b: Any) -> list[str]:
+    """Paths of leaves that differ bit-for-bit (NaNs compare equal)."""
+    bad = []
+    for (path, la), (_, lb) in zip(_leaf_paths(a), _leaf_paths(b)):
+        na, nb = np.asarray(la), np.asarray(lb)
+        if na.shape != nb.shape or na.dtype != nb.dtype:
+            bad.append(path)
+        elif not np.array_equal(na, nb, equal_nan=True):
+            bad.append(path)
+    return bad
+
+
+def check_step_determinism(step_fn: Callable, state: Any, *batch,
+                           runs: int = 2) -> None:
+    """Run ``step_fn(state, *batch)`` `runs` times from the SAME state and
+    require bit-identical outputs.  `step_fn` must not donate its inputs
+    (donation would free `state` after the first call) — build a
+    non-donating step for the check.  Raises :class:`NondeterminismError`.
+    """
+    ref = None
+    for _ in range(runs):
+        out = jax.tree.map(np.asarray, jax.device_get(step_fn(state, *batch)))
+        if ref is None:
+            ref = out
+            continue
+        bad = diff_trees(ref, out)
+        if bad:
+            raise NondeterminismError(bad)

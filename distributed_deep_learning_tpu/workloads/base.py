@@ -302,8 +302,16 @@ def run_workload(spec: WorkloadSpec, config: Config
                 else fsdp_state_spec
             state_spec = make_spec(state, mesh, axis=axis)
         state = place_state(state, mesh, state_spec)
-        train_step, eval_step = make_step_fns(mesh, loss_fn,
-                                              state_spec=state_spec)
+        if config.grad_accum > 1:
+            from distributed_deep_learning_tpu.train.accumulate import (
+                make_accum_step_fns)
+
+            train_step, eval_step = make_accum_step_fns(
+                mesh, loss_fn, accum_steps=config.grad_accum,
+                state_spec=state_spec)
+        else:
+            train_step, eval_step = make_step_fns(mesh, loss_fn,
+                                                  state_spec=state_spec)
         ckpt, start_epoch = _maybe_checkpointer(config)
         if ckpt is not None and start_epoch > 1:
             state = ckpt.restore(state) or state

@@ -1,0 +1,81 @@
+"""Gradient accumulation: k microbatches == one big batch, at every k."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_deep_learning_tpu.data.datasets import synthetic_mqtt
+from distributed_deep_learning_tpu.data.loader import DeviceLoader
+from distributed_deep_learning_tpu.models.mlp import MLP
+from distributed_deep_learning_tpu.train.accumulate import make_accum_step_fns
+from distributed_deep_learning_tpu.train.objectives import cross_entropy_loss
+from distributed_deep_learning_tpu.train.state import create_train_state
+from distributed_deep_learning_tpu.train.step import make_step_fns, place_state
+
+
+def _fresh_state(mesh):
+    model = MLP(hidden_size=16, num_hidden_layers=1)
+    state = create_train_state(model, jax.random.key(0), jnp.zeros((1, 48)),
+                               optax.sgd(0.1))
+    return place_state(state, mesh)
+
+
+def _batches(mesh, n=3):
+    ds = synthetic_mqtt(512, seed=11)
+    loader = DeviceLoader(ds, np.arange(64 * n), 64, mesh, shuffle=False)
+    return list(loader)
+
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_accum_matches_single_step(mesh8, k):
+    batches = _batches(mesh8)
+    plain_step, _ = make_step_fns(mesh8, cross_entropy_loss)
+    accum_step, _ = make_accum_step_fns(mesh8, cross_entropy_loss,
+                                        accum_steps=k)
+
+    s_plain = _fresh_state(mesh8)
+    s_accum = _fresh_state(mesh8)
+    for x, y in batches:
+        s_plain, m_plain = plain_step(s_plain, x, y)
+        s_accum, m_accum = accum_step(s_accum, x, y)
+
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        s_plain.params, s_accum.params)
+    np.testing.assert_allclose(float(m_plain["loss"]),
+                               float(m_accum["loss"]), rtol=1e-5)
+    assert int(m_plain["count"]) == int(m_accum["count"])
+    assert int(m_plain["correct"]) == int(m_accum["correct"])
+
+
+def test_accum_1_is_plain(mesh8):
+    (x, y), = _batches(mesh8, n=1)
+    plain_step, _ = make_step_fns(mesh8, cross_entropy_loss)
+    accum_step, _ = make_accum_step_fns(mesh8, cross_entropy_loss,
+                                        accum_steps=1)
+    s1, _ = plain_step(_fresh_state(mesh8), x, y)
+    s2, _ = accum_step(_fresh_state(mesh8), x, y)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-6), s1.params, s2.params)
+
+
+def test_indivisible_batch_raises(mesh8):
+    accum_step, _ = make_accum_step_fns(mesh8, cross_entropy_loss,
+                                        accum_steps=3)
+    state = _fresh_state(mesh8)
+    x = jnp.zeros((64, 48))
+    y = jnp.zeros((64, 5))
+    with pytest.raises(ValueError):
+        accum_step(state, x, y)
+
+
+def test_cli_grad_accum(monkeypatch):
+    from distributed_deep_learning_tpu.utils.config import parse_args
+    from distributed_deep_learning_tpu.workloads import get_spec, run_workload
+
+    monkeypatch.setenv("DDL_DATA_LIMIT", "512")
+    argv = ["-e", "1", "-b", "64", "-m", "data", "--grad-accum", "2"]
+    _, history = run_workload(get_spec("mlp"), parse_args(argv, workload="mlp"))
+    assert np.isfinite(history[-1].loss)
