@@ -56,13 +56,17 @@ def make_accum_step_fns(mesh: Mesh, loss_fn: Callable, *,
 
     def train_step(state: TrainState, x, y):
         xs, ys = _micro(x, y)
+        micro_idx = jnp.arange(accum_steps)
 
         def micro_grad(model_state, xy):
-            mx, my = xy
+            mx, my, i = xy
+            rngs = state.step_rngs()
+            if rngs is not None:  # distinct stream per microbatch
+                rngs = {k: jax.random.fold_in(r, i) for k, r in rngs.items()}
 
             def compute(params):
                 pred, new_ms = state.apply_fn(params, model_state, mx,
-                                              train=True)
+                                              train=True, rngs=rngs)
                 loss = loss_fn(pred, my)
                 return loss, (prediction_metrics(pred, my, loss), new_ms)
 
@@ -71,7 +75,7 @@ def make_accum_step_fns(mesh: Mesh, loss_fn: Callable, *,
             return new_ms, (grads, metrics)
 
         final_ms, (grads, metrics) = lax.scan(micro_grad, state.model_state,
-                                              (xs, ys))
+                                              (xs, ys, micro_idx))
         mean_grads = jax.tree.map(lambda g: jnp.mean(g, axis=0), grads)
         summed = {
             "loss": jnp.mean(metrics["loss"]),  # mean of microbatch means

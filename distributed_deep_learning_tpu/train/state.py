@@ -17,10 +17,13 @@ import optax
 
 @flax.struct.dataclass
 class TrainState:
-    """``apply_fn(params, model_state, x, train) -> (pred, new_model_state)``
-    — the uniform calling convention all step builders use.  ``model_state``
-    carries non-trained variable collections (BatchNorm running stats);
-    models without any use ``{}``."""
+    """``apply_fn(params, model_state, x, train, rngs=None) ->
+    (pred, new_model_state)`` — the uniform calling convention all step
+    builders use.  ``model_state`` carries non-trained variable collections
+    (BatchNorm running stats); models without any use ``{}``.  ``rng`` (a
+    PRNG key, or None for deterministic models) seeds train-time
+    stochasticity: step builders fold it with ``step`` and pass it as the
+    ``dropout`` stream — reproducible, and never reused across steps."""
 
     step: jax.Array
     params: Any
@@ -28,15 +31,23 @@ class TrainState:
     opt_state: optax.OptState
     apply_fn: Callable = flax.struct.field(pytree_node=False)
     tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+    rng: Any = None
 
     @classmethod
     def create(cls, *, apply_fn: Callable, params: Any,
                tx: optax.GradientTransformation,
-               model_state: Any = None) -> "TrainState":
+               model_state: Any = None, rng: Any = None) -> "TrainState":
         import jax.numpy as jnp
         return cls(step=jnp.zeros((), jnp.int32), params=params,
                    model_state={} if model_state is None else model_state,
-                   opt_state=tx.init(params), apply_fn=apply_fn, tx=tx)
+                   opt_state=tx.init(params), apply_fn=apply_fn, tx=tx,
+                   rng=rng)
+
+    def step_rngs(self) -> "dict | None":
+        """Per-step stochasticity streams, or None when deterministic."""
+        if self.rng is None:
+            return None
+        return {"dropout": jax.random.fold_in(self.rng, self.step)}
 
     def apply_gradients(self, grads: Any, model_state: Any = None) -> "TrainState":
         updates, opt_state = self.tx.update(grads, self.opt_state, self.params)
@@ -47,23 +58,29 @@ class TrainState:
 
 
 def create_train_state(model, rng: jax.Array, example: Any,
-                       tx: optax.GradientTransformation) -> TrainState:
+                       tx: optax.GradientTransformation,
+                       train_rng: jax.Array | None = None) -> TrainState:
     """Build a TrainState from a Flax module following this package's model
     convention: ``model(x, train=...)``, mutable collections beyond
-    ``params`` (e.g. ``batch_stats``) advanced in train mode."""
+    ``params`` (e.g. ``batch_stats``) advanced in train mode.
+
+    ``train_rng`` seeds train-time stochasticity (dropout); omit it for
+    deterministic training (models with dropout then require rate 0)."""
     variables = dict(model.init(rng, example))
     params = variables.pop("params")
     model_state = variables  # batch_stats etc. ({} for stateless models)
 
-    def apply_fn(p, ms, x, train=False):
+    def apply_fn(p, ms, x, train=False, rngs=None):
         v = {"params": p, **ms}
         if train and ms:
-            pred, upd = model.apply(v, x, train=True, mutable=list(ms))
+            pred, upd = model.apply(v, x, train=True, mutable=list(ms),
+                                    rngs=rngs)
             return pred, {**ms, **upd}
-        return model.apply(v, x, train=train), ms
+        return model.apply(v, x, train=train,
+                           rngs=rngs if train else None), ms
 
     return TrainState.create(apply_fn=apply_fn, params=params, tx=tx,
-                             model_state=model_state)
+                             model_state=model_state, rng=train_rng)
 
 
 def reference_optimizer(workload: str, learning_rate: float | None = None,

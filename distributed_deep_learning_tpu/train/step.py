@@ -53,9 +53,11 @@ def make_step_fns(mesh: Mesh, loss_fn: LossFn, *,
     _metrics = prediction_metrics
 
     def train_step(state: TrainState, x, y):
+        rngs = state.step_rngs()
+
         def compute(params):
             pred, new_ms = state.apply_fn(params, state.model_state, x,
-                                          train=True)
+                                          train=True, rngs=rngs)
             loss = loss_fn(pred, y)
             return loss, (_metrics(pred, y, loss), new_ms)
 

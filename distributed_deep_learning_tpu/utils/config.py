@@ -135,6 +135,7 @@ class Config:
     sync_in_local_data_mode: bool = True  # reference quirk Q1 fixed by default
     zero: str = "none"                  # optimizer/param sharding: none|1|fsdp
     grad_accum: int = 1                 # gradient-accumulation microsteps
+    dropout: float = 0.0                # train-time dropout rate (north-star models)
     checkpoint_dir: str | None = None
     resume: bool = False
     profile_dir: str | None = None
@@ -207,6 +208,9 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
     p.add_argument("--no-sync", dest="sync", action="store_false",
                    help="replicate reference quirk Q1 (local data mode trains "
                         "independent replicas)")
+    p.add_argument("--dropout", type=float, default=0.0,
+                   help="dropout rate for transformer/bert workloads "
+                        "(seeded per-step PRNG streams; 0 = deterministic)")
     p.add_argument("--grad-accum", type=int, default=1,
                    help="split each batch into this many sequential "
                         "microbatches, accumulating gradients")
@@ -254,6 +258,7 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         sync_in_local_data_mode=args.sync,
         zero=args.zero,
         grad_accum=args.grad_accum,
+        dropout=args.dropout,
         checkpoint_dir=args.checkpoint_dir,
         resume=args.resume,
         profile_dir=args.profile_dir,

@@ -291,7 +291,10 @@ def run_workload(spec: WorkloadSpec, config: Config
         loaders = make_loaders(dataset, splits, config.batch_size, mesh,
                                seed=config.seed)
         model = spec.build_model(config, dataset)
-        state = create_train_state(model, rng, example, tx)
+        train_rng = (jax.random.key(config.seed + 1)
+                     if config.dropout > 0 else None)
+        state = create_train_state(model, rng, example, tx,
+                                   train_rng=train_rng)
         state_spec = P()
         if config.zero != "none":
             from distributed_deep_learning_tpu.parallel.zero import (

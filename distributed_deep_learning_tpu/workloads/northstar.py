@@ -103,13 +103,12 @@ def _wmt_dataset(config: Config, src_len: int = 32, tgt_len: int = 32,
 
 def _transformer_model(config: Config, dataset):
     d = config.size
-    # dropout_rate=0: the shared runner drives models without PRNG threading
-    # (deterministic steps, the reference's seed-42 contract); pass explicit
-    # rngs to model.apply for stochastic training outside the runner
+    # --dropout seeds per-step PRNG streams through TrainState.rng;
+    # the default 0.0 keeps steps deterministic (reference seed-42 contract)
     inner = TransformerSeq2Seq(
         vocab_size=1024, num_layers=config.num_layers, d_model=d,
-        num_heads=max(2, d // 64), mlp_dim=4 * d, dropout_rate=0.0,
-        dtype=config_dtype(config))
+        num_heads=max(2, d // 64), mlp_dim=4 * d,
+        dropout_rate=config.dropout, dtype=config_dtype(config))
     src_len = dataset.features.shape[1] - dataset.targets.shape[1]
     return Seq2SeqAdapter(inner, src_len)
 
@@ -142,7 +141,8 @@ def _bert_model(config: Config, dataset):
     d = config.size
     return BertEncoder(vocab_size=1024, num_layers=config.num_layers,
                        d_model=d, num_heads=max(2, d // 64), mlp_dim=4 * d,
-                       dropout_rate=0.0, dtype=config_dtype(config))
+                       dropout_rate=config.dropout,
+                       dtype=config_dtype(config))
 
 
 BERT_SPEC = WorkloadSpec(

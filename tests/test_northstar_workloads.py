@@ -82,3 +82,26 @@ def test_cli_defaults():
     assert c.num_layers == 12 and c.size == 768
     c = parse_args([], workload="resnet")
     assert c.size == 18
+
+
+def test_dropout_trains_and_is_seeded():
+    """--dropout 0.1 trains (PRNG streams threaded through the jitted step)
+    and two identical runs produce identical metric streams."""
+    _, h1 = _run("bert", ["-l", "1", "-s", "32", "-e", "1", "-b", "32",
+                          "-m", "data", "--dropout", "0.1"])
+    _, h2 = _run("bert", ["-l", "1", "-s", "32", "-e", "1", "-b", "32",
+                          "-m", "data", "--dropout", "0.1"])
+    _ok(h1)
+    losses1 = [h.loss for h in h1]
+    losses2 = [h.loss for h in h2]
+    np.testing.assert_allclose(losses1, losses2, rtol=0, atol=0)
+
+
+def test_dropout_changes_training_vs_deterministic():
+    _, h_det = _run("bert", ["-l", "1", "-s", "32", "-e", "1", "-b", "32",
+                             "-m", "data"])
+    _, h_drop = _run("bert", ["-l", "1", "-s", "32", "-e", "1", "-b", "32",
+                              "-m", "data", "--dropout", "0.3"])
+    t_det = [h for h in h_det if h.phase == "train"][0]
+    t_drop = [h for h in h_drop if h.phase == "train"][0]
+    assert t_det.loss != t_drop.loss  # dropout actually active
