@@ -101,6 +101,39 @@ def shard_params(params: Any, mesh: Mesh, rules: Rules) -> Any:
         params, jax.tree.map(lambda s: NamedSharding(mesh, s), specs))
 
 
+def tp_state_spec(state: Any, rules: Rules) -> Any:
+    """TrainState-shaped PartitionSpec pytree for tensor parallelism.
+
+    Params get rule-derived specs; optimizer-state subtrees that mirror the
+    param tree (optax moments ``mu``/``nu`` etc.) inherit the SAME specs —
+    sharded params need sharded moments or jit would all-gather them every
+    step; everything else (counts, schedules, batch stats) is replicated.
+    Compose with the step builders:
+    ``make_step_fns(mesh, loss, state_spec=tp_state_spec(state, rules))``.
+    """
+    p_specs = param_specs(state.params, rules)
+    params_def = jax.tree_util.tree_structure(state.params)
+
+    def params_like(x: Any) -> bool:
+        try:
+            return jax.tree_util.tree_structure(x) == params_def
+        except Exception:
+            return False
+
+    def opt_map(node: Any) -> Any:
+        return p_specs if params_like(node) else jax.tree.map(
+            lambda _: P(), node)
+
+    opt_specs = jax.tree.map(opt_map, state.opt_state, is_leaf=params_like)
+    return state.replace(
+        step=P(),
+        params=p_specs,
+        model_state=jax.tree.map(lambda _: P(), state.model_state),
+        opt_state=opt_specs,
+        rng=P() if getattr(state, "rng", None) is not None else None,
+    )
+
+
 def validate_divisibility(params: Any, mesh: Mesh, rules: Rules) -> None:
     """Fail fast when a rule's axis doesn't divide the parameter dim."""
     specs = param_specs(params, rules)

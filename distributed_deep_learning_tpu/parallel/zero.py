@@ -65,6 +65,7 @@ def zero1_state_spec(state: TrainState, mesh: Mesh, *, axis: str = "fsdp",
         params=_replicated(state.params),
         model_state=_replicated(state.model_state),
         opt_state=_tree_specs(state.opt_state, n, axis, min_leaf_size),
+        rng=P() if state.rng is not None else None,
     )
 
 
@@ -77,4 +78,5 @@ def fsdp_state_spec(state: TrainState, mesh: Mesh, *, axis: str = "fsdp",
         params=_tree_specs(state.params, n, axis, min_leaf_size),
         model_state=_replicated(state.model_state),
         opt_state=_tree_specs(state.opt_state, n, axis, min_leaf_size),
+        rng=P() if state.rng is not None else None,
     )

@@ -70,6 +70,8 @@ class WorkloadSpec:
     build_optimizer: Callable[[Config, int], optax.GradientTransformation]
     # (1, ...) example input for init, derived from the dataset
     example_input: Callable[[Config, Any], jnp.ndarray]
+    # optional: tensor-parallel sharding rules (enables --mesh model=K)
+    tp_rules: Callable[[Config], Any] | None = None
 
 
 def config_dtype(config: Config) -> jnp.dtype:
@@ -296,7 +298,20 @@ def run_workload(spec: WorkloadSpec, config: Config
         state = create_train_state(model, rng, example, tx,
                                    train_rng=train_rng)
         state_spec = P()
-        if config.zero != "none":
+        if mesh.shape.get("model", 1) > 1:
+            if spec.tp_rules is None:
+                raise ValueError(f"workload {spec.name!r} has no "
+                                 "tensor-parallel sharding rules")
+            if config.zero != "none":
+                raise ValueError("--zero with a model axis is not supported "
+                                 "yet; use fsdp_axis in the TP rules instead")
+            from distributed_deep_learning_tpu.parallel.tensor_parallel import (
+                tp_state_spec, validate_divisibility)
+
+            rules = spec.tp_rules(config)
+            validate_divisibility(state.params, mesh, rules)
+            state_spec = tp_state_spec(state, rules)
+        elif config.zero != "none":
             from distributed_deep_learning_tpu.parallel.zero import (
                 fsdp_state_spec, zero1_state_spec)
 

@@ -36,6 +36,8 @@ from distributed_deep_learning_tpu.models.resnet import (BasicBlock,
                                                          ResNet)
 from distributed_deep_learning_tpu.models.transformer import (BertEncoder,
                                                               TransformerSeq2Seq)
+from distributed_deep_learning_tpu.parallel.tensor_parallel import (
+    transformer_tp_rules)
 from distributed_deep_learning_tpu.train.objectives import (
     cross_entropy_loss, token_cross_entropy)
 from distributed_deep_learning_tpu.utils.config import Config, parse_args
@@ -123,6 +125,7 @@ TRANSFORMER_SPEC = WorkloadSpec(
     build_optimizer=lambda c, steps: optax.adamw(c.learning_rate),
     example_input=lambda c, ds: jnp.zeros((1, ds.features.shape[1]),
                                           jnp.int32),
+    tp_rules=lambda c: transformer_tp_rules(),
 )
 
 
@@ -155,6 +158,7 @@ BERT_SPEC = WorkloadSpec(
     build_optimizer=lambda c, steps: optax.adamw(c.learning_rate),
     example_input=lambda c, ds: jnp.zeros((1, ds.features.shape[1]),
                                           jnp.int32),
+    tp_rules=lambda c: transformer_tp_rules(),
 )
 
 SPECS = {"resnet": RESNET_SPEC, "transformer": TRANSFORMER_SPEC,

@@ -105,3 +105,22 @@ def test_dropout_changes_training_vs_deterministic():
     t_det = [h for h in h_det if h.phase == "train"][0]
     t_drop = [h for h in h_drop if h.phase == "train"][0]
     assert t_det.loss != t_drop.loss  # dropout actually active
+
+
+def test_tensor_parallel_cli_matches_replicated():
+    """--mesh data=4,model=2 shards attention/MLP/embedding without
+    changing the math (XLA inserts the Megatron collectives)."""
+    _, h_repl = _run("bert", ["-l", "1", "-s", "64", "-e", "1", "-b", "32",
+                              "-m", "data"])
+    _, h_tp = _run("bert", ["-l", "1", "-s", "64", "-e", "1", "-b", "32",
+                            "-m", "data", "--mesh", "data=4,model=2"])
+    t_repl = [h for h in h_repl if h.phase == "train"][0]
+    t_tp = [h for h in h_tp if h.phase == "train"][0]
+    np.testing.assert_allclose(t_repl.loss, t_tp.loss, rtol=1e-4)
+    np.testing.assert_allclose(t_repl.accuracy, t_tp.accuracy, atol=0.2)
+
+
+def test_tensor_parallel_rejected_without_rules():
+    with pytest.raises(ValueError, match="tensor-parallel"):
+        _run("resnet", ["-e", "1", "-b", "32", "-m", "data",
+                        "--mesh", "data=2,model=4"])
