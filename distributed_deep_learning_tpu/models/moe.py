@@ -86,6 +86,7 @@ class MoEMLP(nn.Module):
     mlp_dim: int = 2048
     capacity_factor: float = 2.0
     dtype: jnp.dtype = jnp.float32
+    aux_loss_weight: float = 1.0  # scales the sown load-balance loss
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -99,7 +100,7 @@ class MoEMLP(nn.Module):
                           name="router")
         logits = router(tokens.astype(jnp.float32))
         dispatch, combine, aux_loss = top2_gating(logits, capacity)
-        self.sow("losses", "moe_aux_loss", aux_loss)
+        self.sow("losses", "moe_aux_loss", self.aux_loss_weight * aux_loss)
 
         w_in = self.param("w_in", dense_init, (E, d, self.mlp_dim),
                           jnp.float32).astype(self.dtype)
@@ -139,6 +140,7 @@ class MoETransformerLayer(nn.Module):
     capacity_factor: float = 2.0
     dropout_rate: float = 0.1
     dtype: jnp.dtype = jnp.float32
+    aux_loss_weight: float = 1.0
 
     @nn.compact
     def __call__(self, x, *, self_mask=None, train: bool = False):
@@ -152,6 +154,50 @@ class MoETransformerLayer(nn.Module):
         x = x + h
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = MoEMLP(self.num_experts, self.mlp_dim, self.capacity_factor,
-                   self.dtype, name="moe")(h, train=train)
+                   self.dtype, self.aux_loss_weight,
+                   name="moe")(h, train=train)
         h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
         return x + h
+
+
+class MoELM(nn.Module):
+    """Masked-LM encoder with routed-MoE MLPs in every other block — the
+    sparse-expert member of the north-star family.  Dense blocks carry the
+    odd layers; even layers route through ``num_experts`` experts whose
+    weights shard over the ``expert`` mesh axis
+    (:func:`moe_param_rules`).  The load-balance losses are sown and picked
+    up by the training state's aux-loss convention."""
+
+    vocab_size: int = 1024
+    num_layers: int = 4
+    d_model: int = 256
+    num_heads: int = 4
+    mlp_dim: int = 1024
+    num_experts: int = 8
+    capacity_factor: float = 2.0
+    aux_loss_weight: float = 1e-2
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        from distributed_deep_learning_tpu.models.transformer import (
+            Embed, TransformerLayer)
+
+        pad = (tokens != 0)[:, None, None, :]
+        x, emb = Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                       name="embed")(tokens)
+        for i in range(self.num_layers):
+            if i % 2 == 1:
+                x = MoETransformerLayer(
+                    self.num_heads, self.num_experts, self.mlp_dim,
+                    self.capacity_factor, self.dropout_rate, self.dtype,
+                    self.aux_loss_weight, name=f"moe_layer_{i}")(
+                        x, self_mask=pad, train=train)
+            else:
+                x = TransformerLayer(self.num_heads, self.mlp_dim,
+                                     self.dropout_rate, dtype=self.dtype,
+                                     name=f"layer_{i}")(x, self_mask=pad,
+                                                        train=train)
+        x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
+        return Embed.logits(x, emb)

@@ -65,10 +65,11 @@ def make_accum_step_fns(mesh: Mesh, loss_fn: Callable, *,
                 rngs = {k: jax.random.fold_in(r, i) for k, r in rngs.items()}
 
             def compute(params):
-                pred, new_ms = state.apply_fn(params, model_state, mx,
-                                              train=True, rngs=rngs)
+                pred, new_ms, aux = state.apply_fn(params, model_state, mx,
+                                                   train=True, rngs=rngs)
                 loss = loss_fn(pred, my)
-                return loss, (prediction_metrics(pred, my, loss), new_ms)
+                return loss + aux, (prediction_metrics(pred, my, loss),
+                                    new_ms)
 
             (_, (metrics, new_ms)), grads = jax.value_and_grad(
                 compute, has_aux=True)(state.params)
@@ -86,8 +87,8 @@ def make_accum_step_fns(mesh: Mesh, loss_fn: Callable, *,
         return new_state, summed
 
     def eval_step(state: TrainState, x, y):
-        pred, _ = state.apply_fn(state.params, state.model_state, x,
-                                 train=False)
+        pred, _, _ = state.apply_fn(state.params, state.model_state, x,
+                                    train=False)
         return prediction_metrics(pred, y, loss_fn(pred, y))
 
     train_step = jax.jit(train_step,

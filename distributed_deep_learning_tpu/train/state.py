@@ -18,12 +18,14 @@ import optax
 @flax.struct.dataclass
 class TrainState:
     """``apply_fn(params, model_state, x, train, rngs=None) ->
-    (pred, new_model_state)`` — the uniform calling convention all step
-    builders use.  ``model_state`` carries non-trained variable collections
-    (BatchNorm running stats); models without any use ``{}``.  ``rng`` (a
-    PRNG key, or None for deterministic models) seeds train-time
-    stochasticity: step builders fold it with ``step`` and pass it as the
-    ``dropout`` stream — reproducible, and never reused across steps."""
+    (pred, new_model_state, aux_loss)`` — the uniform calling convention all
+    step builders use.  ``model_state`` carries non-trained variable
+    collections (BatchNorm running stats); models without any use ``{}``;
+    ``aux_loss`` is the summed ``losses`` collection (0 for models without
+    one), added to the task loss by train steps.  ``rng`` (a PRNG key, or
+    None for deterministic models) seeds train-time stochasticity: step
+    builders fold it with ``step`` and pass it as the ``dropout`` stream —
+    reproducible, and never reused across steps."""
 
     step: jax.Array
     params: Any
@@ -65,19 +67,37 @@ def create_train_state(model, rng: jax.Array, example: Any,
     ``params`` (e.g. ``batch_stats``) advanced in train mode.
 
     ``train_rng`` seeds train-time stochasticity (dropout); omit it for
-    deterministic training (models with dropout then require rate 0)."""
+    deterministic training (models with dropout then require rate 0).
+
+    Auxiliary losses: values the model ``sow``s into a ``losses`` collection
+    (e.g. the MoE load-balance loss) are summed into the returned ``aux``
+    scalar each train step — step builders add it to the task loss — and
+    are never persisted in ``model_state``.
+    """
+    import jax.numpy as jnp
+
     variables = dict(model.init(rng, example))
     params = variables.pop("params")
+    has_losses = "losses" in variables
+    variables.pop("losses", None)  # sown values must not accumulate
     model_state = variables  # batch_stats etc. ({} for stateless models)
 
     def apply_fn(p, ms, x, train=False, rngs=None):
+        """→ (pred, new_model_state, aux_loss)."""
         v = {"params": p, **ms}
-        if train and ms:
-            pred, upd = model.apply(v, x, train=True, mutable=list(ms),
+        mutable = (list(ms) + (["losses"] if has_losses else [])) if train \
+            else []
+        if mutable:
+            pred, upd = model.apply(v, x, train=True, mutable=mutable,
                                     rngs=rngs)
-            return pred, {**ms, **upd}
-        return model.apply(v, x, train=train,
-                           rngs=rngs if train else None), ms
+            upd = dict(upd)
+            aux_tree = upd.pop("losses", {})
+            aux = sum((jnp.sum(l) for l in jax.tree.leaves(aux_tree)),
+                      jnp.zeros((), jnp.float32))
+            return pred, {**ms, **upd}, aux
+        return (model.apply(v, x, train=train,
+                            rngs=rngs if train else None),
+                ms, jnp.zeros((), jnp.float32))
 
     return TrainState.create(apply_fn=apply_fn, params=params, tx=tx,
                              model_state=model_state, rng=train_rng)

@@ -56,18 +56,20 @@ def make_step_fns(mesh: Mesh, loss_fn: LossFn, *,
         rngs = state.step_rngs()
 
         def compute(params):
-            pred, new_ms = state.apply_fn(params, state.model_state, x,
-                                          train=True, rngs=rngs)
+            pred, new_ms, aux = state.apply_fn(params, state.model_state, x,
+                                               train=True, rngs=rngs)
             loss = loss_fn(pred, y)
-            return loss, (_metrics(pred, y, loss), new_ms)
+            # gradient objective includes the model's aux losses (MoE load
+            # balance etc.); logged metrics report the task loss
+            return loss + aux, (_metrics(pred, y, loss), new_ms)
 
         grad_fn = jax.value_and_grad(compute, has_aux=True)
         (_, (metrics, new_ms)), grads = grad_fn(state.params)
         return state.apply_gradients(grads, model_state=new_ms), metrics
 
     def eval_step(state: TrainState, x, y):
-        pred, _ = state.apply_fn(state.params, state.model_state, x,
-                                 train=False)
+        pred, _, _ = state.apply_fn(state.params, state.model_state, x,
+                                    train=False)
         return _metrics(pred, y, loss_fn(pred, y))
 
     train_step = jax.jit(

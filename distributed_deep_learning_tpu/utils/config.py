@@ -161,6 +161,7 @@ WORKLOAD_DEFAULTS: dict[str, dict[str, int]] = {
     "resnet": {"nlayers": 4, "size": 18},
     "transformer": {"nlayers": 6, "size": 512},
     "bert": {"nlayers": 12, "size": 768},
+    "moe": {"nlayers": 4, "size": 256},
 }
 
 

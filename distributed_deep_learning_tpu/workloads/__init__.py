@@ -14,7 +14,7 @@ def get_spec(name: str):
         from distributed_deep_learning_tpu.workloads.cnn import SPEC
     elif name == "lstm":
         from distributed_deep_learning_tpu.workloads.lstm import SPEC
-    elif name in ("resnet", "transformer", "bert"):
+    elif name in ("resnet", "transformer", "bert", "moe"):
         from distributed_deep_learning_tpu.workloads.northstar import SPECS
         return SPECS[name]
     else:
@@ -23,4 +23,4 @@ def get_spec(name: str):
     return SPEC
 
 
-WORKLOADS = ("mlp", "cnn", "lstm", "resnet", "transformer", "bert")
+WORKLOADS = ("mlp", "cnn", "lstm", "resnet", "transformer", "bert", "moe")

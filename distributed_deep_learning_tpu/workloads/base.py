@@ -298,7 +298,7 @@ def run_workload(spec: WorkloadSpec, config: Config
         state = create_train_state(model, rng, example, tx,
                                    train_rng=train_rng)
         state_spec = P()
-        if mesh.shape.get("model", 1) > 1:
+        if mesh.shape.get("model", 1) > 1 or mesh.shape.get("expert", 1) > 1:
             if spec.tp_rules is None:
                 raise ValueError(f"workload {spec.name!r} has no "
                                  "tensor-parallel sharding rules")

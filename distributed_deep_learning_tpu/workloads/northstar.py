@@ -161,8 +161,41 @@ BERT_SPEC = WorkloadSpec(
     tp_rules=lambda c: transformer_tp_rules(),
 )
 
+# --- moe (sparse-expert MLM) -----------------------------------------------
+
+def _moe_model(config: Config, dataset):
+    from distributed_deep_learning_tpu.models.moe import MoELM
+
+    d = config.size
+    return MoELM(vocab_size=1024, num_layers=config.num_layers, d_model=d,
+                 num_heads=max(2, d // 64), mlp_dim=4 * d,
+                 num_experts=8, dropout_rate=config.dropout,
+                 dtype=config_dtype(config))
+
+
+def _moe_rules(config: Config):
+    """Expert weights over `expert`; everything else replicated (dense
+    blocks could add the Megatron rules, kept replicated for clarity)."""
+    from distributed_deep_learning_tpu.models.moe import moe_param_rules
+
+    return moe_param_rules()
+
+
+MOE_SPEC = WorkloadSpec(
+    name="moe",
+    build_dataset=_mlm_dataset,
+    build_model=_moe_model,
+    build_layers=_no_staging,
+    partitioner=lambda n, s: np.zeros(n, np.int64),
+    build_loss=lambda c: token_cross_entropy,
+    build_optimizer=lambda c, steps: optax.adamw(c.learning_rate),
+    example_input=lambda c, ds: jnp.zeros((1, ds.features.shape[1]),
+                                          jnp.int32),
+    tp_rules=_moe_rules,
+)
+
 SPECS = {"resnet": RESNET_SPEC, "transformer": TRANSFORMER_SPEC,
-         "bert": BERT_SPEC}
+         "bert": BERT_SPEC, "moe": MOE_SPEC}
 
 
 def main(argv=None, workload: str = "resnet"):

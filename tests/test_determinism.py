@@ -40,7 +40,7 @@ def test_train_step_is_deterministic(mesh8):
     # non-donating step: determinism checks reuse the same state object
     def step(state, x, y):
         def loss(p):
-            pred, _ = state.apply_fn(p, state.model_state, x, train=True)
+            pred, _, _ = state.apply_fn(p, state.model_state, x, train=True)
             return cross_entropy_loss(pred, y)
 
         return jax.jit(jax.value_and_grad(loss))(state.params)
