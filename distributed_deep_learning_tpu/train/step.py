@@ -37,7 +37,8 @@ def _state_sharding(mesh: Mesh, state_spec):
 
 
 def make_step_fns(mesh: Mesh, loss_fn: LossFn, *,
-                  state_spec=P(), batch_spec=P(BATCH_AXES)):
+                  state_spec=P(), batch_spec=P(BATCH_AXES),
+                  remat: bool = False):
     """Build (train_step, eval_step), jitted with explicit shardings.
 
     ``state_spec`` defaults to fully-replicated parameters/optimizer state
@@ -45,6 +46,11 @@ def make_step_fns(mesh: Mesh, loss_fn: LossFn, *,
     (:mod:`..parallel.zero`); the step body is identical — only the
     shardings change, and XLA inserts the reduce-scatter/all-gather
     dataflow those schemes describe.
+
+    ``remat=True`` wraps the forward in ``jax.checkpoint``: backward
+    recomputes activations instead of storing them — the HBM-for-FLOPs
+    trade that lets batch/model sizes exceed activation memory.  Numerics
+    are unchanged.
     """
     state_sh = _state_sharding(mesh, state_spec)
     batch_sh = NamedSharding(mesh, batch_spec)
@@ -56,8 +62,15 @@ def make_step_fns(mesh: Mesh, loss_fn: LossFn, *,
         rngs = state.step_rngs()
 
         def compute(params):
-            pred, new_ms, aux = state.apply_fn(params, state.model_state, x,
-                                               train=True, rngs=rngs)
+            fwd = state.apply_fn
+            if remat:
+                fwd = jax.checkpoint(
+                    lambda p, ms, xx: state.apply_fn(p, ms, xx, train=True,
+                                                     rngs=rngs))
+                pred, new_ms, aux = fwd(params, state.model_state, x)
+            else:
+                pred, new_ms, aux = fwd(params, state.model_state, x,
+                                        train=True, rngs=rngs)
             loss = loss_fn(pred, y)
             # gradient objective includes the model's aux losses (MoE load
             # balance etc.); logged metrics report the task loss

@@ -136,6 +136,7 @@ class Config:
     zero: str = "none"                  # optimizer/param sharding: none|1|fsdp
     grad_accum: int = 1                 # gradient-accumulation microsteps
     dropout: float = 0.0                # train-time dropout rate (north-star models)
+    remat: bool = False                 # rematerialise activations in backward
     checkpoint_dir: str | None = None
     resume: bool = False
     profile_dir: str | None = None
@@ -209,6 +210,9 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
     p.add_argument("--no-sync", dest="sync", action="store_false",
                    help="replicate reference quirk Q1 (local data mode trains "
                         "independent replicas)")
+    p.add_argument("--remat", action="store_true",
+                   help="recompute activations in backward (jax.checkpoint) "
+                        "— trades FLOPs for HBM")
     p.add_argument("--dropout", type=float, default=0.0,
                    help="dropout rate for transformer/bert workloads "
                         "(seeded per-step PRNG streams; 0 = deterministic)")
@@ -260,6 +264,7 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         zero=args.zero,
         grad_accum=args.grad_accum,
         dropout=args.dropout,
+        remat=args.remat,
         checkpoint_dir=args.checkpoint_dir,
         resume=args.resume,
         profile_dir=args.profile_dir,

@@ -329,7 +329,8 @@ def run_workload(spec: WorkloadSpec, config: Config
                 state_spec=state_spec)
         else:
             train_step, eval_step = make_step_fns(mesh, loss_fn,
-                                                  state_spec=state_spec)
+                                                  state_spec=state_spec,
+                                                  remat=config.remat)
         ckpt, start_epoch = _maybe_checkpointer(config)
         if ckpt is not None and start_epoch > 1:
             state = ckpt.restore(state) or state

@@ -79,3 +79,19 @@ def test_cli_grad_accum(monkeypatch):
     argv = ["-e", "1", "-b", "64", "-m", "data", "--grad-accum", "2"]
     _, history = run_workload(get_spec("mlp"), parse_args(argv, workload="mlp"))
     assert np.isfinite(history[-1].loss)
+
+
+def test_remat_matches_plain_step(mesh8):
+    """--remat recomputes activations in backward without changing math."""
+    batches = _batches(mesh8, n=2)
+    plain_step, _ = make_step_fns(mesh8, cross_entropy_loss)
+    remat_step, _ = make_step_fns(mesh8, cross_entropy_loss, remat=True)
+    s_plain, s_remat = _fresh_state(mesh8), _fresh_state(mesh8)
+    for x, y in batches:
+        s_plain, m1 = plain_step(s_plain, x, y)
+        s_remat, m2 = remat_step(s_remat, x, y)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-6), s_plain.params,
+        s_remat.params)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                               rtol=1e-6)
