@@ -1,0 +1,93 @@
+"""Elastic recovery: a failing run restores from checkpoint and finishes
+equal to an uninterrupted run."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_deep_learning_tpu.data.datasets import synthetic_mqtt
+from distributed_deep_learning_tpu.data.loader import make_loaders
+from distributed_deep_learning_tpu.data.splits import train_val_test_split
+from distributed_deep_learning_tpu.models.mlp import MLP
+from distributed_deep_learning_tpu.train.elastic import fit_with_recovery
+from distributed_deep_learning_tpu.train.loop import fit
+from distributed_deep_learning_tpu.train.objectives import cross_entropy_loss
+from distributed_deep_learning_tpu.train.state import create_train_state
+from distributed_deep_learning_tpu.train.step import make_step_fns, place_state
+from distributed_deep_learning_tpu.utils.checkpoint import Checkpointer
+from distributed_deep_learning_tpu.utils.failures import (FailureMonitor,
+                                                          Heartbeat,
+                                                          WorkerFailure)
+
+
+def _setup(mesh):
+    ds = synthetic_mqtt(1024, seed=21)
+    splits = train_val_test_split(len(ds), seed=42)
+    loaders = make_loaders(ds, splits, 64, mesh)
+    model = MLP(hidden_size=16)
+
+    def make_state():
+        state = create_train_state(model, jax.random.key(7),
+                                   jnp.zeros((1, 48)), optax.sgd(0.05))
+        return place_state(state, mesh)
+
+    steps = make_step_fns(mesh, cross_entropy_loss)
+    return make_state, steps, loaders
+
+
+def test_recovers_and_matches_uninterrupted(tmp_path, mesh8):
+    make_state, (train_step, eval_step), loaders = _setup(mesh8)
+
+    # uninterrupted reference run
+    ref_state, ref_hist = fit(make_state(), train_step, eval_step, *loaders,
+                              epochs=4)
+
+    # a train step that blows up once, in epoch 3 of the first attempt
+    boom = {"armed": True, "calls": 0}
+
+    def flaky_step(state, x, y):
+        boom["calls"] += 1
+        # epoch = 11 steps (716 train examples / 64); fail early in epoch 3
+        if boom["armed"] and boom["calls"] > 2 * 11 + 1:
+            boom["armed"] = False
+            raise RuntimeError("injected failure (simulated preemption)")
+        return train_step(state, x, y)
+
+    with Checkpointer(tmp_path / "elastic") as ckpt:
+        state, hist = fit_with_recovery(make_state, flaky_step, eval_step,
+                                        loaders, epochs=4, checkpointer=ckpt)
+
+    # recovered run trained all 4 epochs; epochs 3-4 resumed post-failure
+    train_epochs = [h.epoch for h in hist if h.phase == "train"]
+    assert train_epochs[-1] == 4 and 3 in train_epochs
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7),
+        ref_state.params, state.params)
+
+
+def test_gives_up_after_max_restarts(tmp_path, mesh8):
+    make_state, (train_step, eval_step), loaders = _setup(mesh8)
+
+    def always_fails(state, x, y):
+        raise RuntimeError("permanently broken")
+
+    with Checkpointer(tmp_path / "dead") as ckpt:
+        with pytest.raises(RuntimeError, match="permanently broken"):
+            fit_with_recovery(make_state, always_fails, eval_step, loaders,
+                              epochs=2, checkpointer=ckpt, max_restarts=1)
+
+
+def test_monitor_failure_triggers_recovery_path(tmp_path, mesh8):
+    """A WorkerFailure from the monitor counts as a recoverable failure."""
+    make_state, (train_step, eval_step), loaders = _setup(mesh8)
+    d = str(tmp_path / "hb")
+    Heartbeat(d, rank=0).beat_once()  # rank 1 never beats
+    monitor = FailureMonitor(d, world_size=2, timeout=1.0, self_rank=0)
+
+    with Checkpointer(tmp_path / "mon") as ckpt:
+        with pytest.raises(WorkerFailure):
+            fit_with_recovery(make_state, train_step, eval_step, loaders,
+                              epochs=1, checkpointer=ckpt, monitor=monitor,
+                              max_restarts=1)
