@@ -1,0 +1,93 @@
+"""Generic directory-per-class image dataset (ImageFolder semantics).
+
+The reference's image pipeline is PCB-specific (VOC XML + bbox crops,
+:mod:`.pcb`); this is the general-purpose sibling for ImageNet-style
+layouts ``root/<class>/<image>``, matching torchvision ``ImageFolder``
+class-discovery semantics (sorted class names → indices).  Decode uses
+PIL, resize uses the native C++ bilinear kernel
+(:func:`..native.crop_resize_bilinear`), batches decode in parallel
+threads (PIL decode releases the GIL), and everything downstream is the
+standard ``ArrayDataset`` contract (``__len__``/``batch``) feeding the
+sharded :class:`..loader.DeviceLoader`.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+IMAGE_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp")
+
+
+def find_classes(root: str) -> tuple[list[str], dict[str, int]]:
+    """Sorted class subdirectories → contiguous indices (torchvision
+    ``ImageFolder`` semantics)."""
+    classes = sorted(e.name for e in os.scandir(root) if e.is_dir())
+    if not classes:
+        raise FileNotFoundError(f"no class directories under {root}")
+    return classes, {c: i for i, c in enumerate(classes)}
+
+
+class ImageFolderDataset:
+    """``root/<class>/*.jpg`` → (image, one-hot) batches."""
+
+    def __init__(self, root: str, image_size: int = 224, *,
+                 num_workers: int = 8, max_cached_images: int = 1024):
+        self.root = os.fspath(root)
+        self.image_size = image_size
+        self.classes, self.class_to_idx = find_classes(self.root)
+        self.samples: list[tuple[str, int]] = []
+        for cls in self.classes:
+            cdir = os.path.join(self.root, cls)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for name in sorted(files):
+                    if name.lower().endswith(IMAGE_EXTENSIONS):
+                        self.samples.append((os.path.join(dirpath, name),
+                                             self.class_to_idx[cls]))
+        if not self.samples:
+            raise FileNotFoundError(f"no images under {root}")
+        self._pool = ThreadPoolExecutor(max(1, num_workers)) \
+            if num_workers > 1 else None
+        self._cache: OrderedDict[str, np.ndarray] = OrderedDict()
+        self._max_cached = max_cached_images
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def _decode(self, path: str) -> np.ndarray:
+        img = self._cache.get(path)
+        if img is not None:
+            self._cache.move_to_end(path)
+            return img
+        from PIL import Image
+
+        from distributed_deep_learning_tpu import native
+
+        with Image.open(path) as im:
+            raw = np.asarray(im.convert("RGB"), dtype=np.float32)
+        h, w = raw.shape[:2]
+        img = native.crop_resize_bilinear(np.ascontiguousarray(raw), 0, 0,
+                                          h, w, self.image_size,
+                                          self.image_size)
+        self._cache[path] = img
+        while len(self._cache) > self._max_cached:
+            self._cache.popitem(last=False)
+        return img
+
+    def item(self, index: int) -> tuple[np.ndarray, np.ndarray]:
+        path, target = self.samples[index]
+        y = np.zeros(len(self.classes), dtype=np.float32)
+        y[target] = 1.0
+        return self._decode(path), y
+
+    def batch(self, indices: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        idx = [int(i) for i in np.asarray(indices)]
+        if self._pool is not None:
+            items = list(self._pool.map(self.item, idx))
+        else:
+            items = [self.item(i) for i in idx]
+        return (np.stack([x for x, _ in items]),
+                np.stack([y for _, y in items]))
