@@ -82,6 +82,10 @@ def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
         t1 = logger.clock()
         res = _result("train", epoch, totals, t0, t1)
         logger.phase_end("train", epoch, accuracy=res.accuracy, loss=res.loss)
+        # beyond-reference observability: throughput counters per phase
+        logger.metrics(phase="train", epoch=epoch,
+                       examples_per_sec=round(res.examples_per_sec, 1),
+                       examples=res.examples)
         history.append(res)
 
         t0 = logger.clock()

@@ -1,0 +1,49 @@
+"""Learning-rate schedules for the workload families.
+
+The reference's only schedule is StepLR(7 epochs, ×0.1) on the CNN
+(``CNN/main.py:161``, reproduced in
+:func:`..state.reference_optimizer`).  The north-star families need the
+standard TPU-era recipes, provided here as optax schedules:
+
+* :func:`warmup_cosine` — linear warmup → cosine decay (ResNet/BERT).
+* :func:`warmup_rsqrt` — the transformer-base "Noam" schedule
+  (Vaswani et al.): lr ∝ d_model^-0.5 · min(step^-0.5, step·warmup^-1.5).
+* :func:`step_decay` — the reference's StepLR, generalised.
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def warmup_cosine(peak_lr: float, warmup_steps: int, total_steps: int,
+                  end_factor: float = 0.0) -> optax.Schedule:
+    """Linear 0→peak over `warmup_steps`, cosine peak→end over the rest."""
+    if total_steps <= warmup_steps:
+        raise ValueError(f"total_steps {total_steps} must exceed "
+                         f"warmup_steps {warmup_steps}")
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=peak_lr, warmup_steps=warmup_steps,
+        decay_steps=total_steps, end_value=peak_lr * end_factor)
+
+
+def warmup_rsqrt(d_model: int, warmup_steps: int = 4000,
+                 scale: float = 1.0) -> optax.Schedule:
+    """Transformer-base (Noam) schedule."""
+
+    def schedule(step):
+        import jax.numpy as jnp
+
+        step = jnp.maximum(step, 1).astype(jnp.float32)
+        return scale * d_model ** -0.5 * jnp.minimum(
+            step ** -0.5, step * warmup_steps ** -1.5)
+
+    return schedule
+
+
+def step_decay(base_lr: float, steps_per_drop: int,
+               factor: float = 0.1) -> optax.Schedule:
+    """The reference's StepLR as an optax schedule (drop every
+    `steps_per_drop` optimizer steps)."""
+    return optax.exponential_decay(base_lr, transition_steps=steps_per_drop,
+                                   decay_rate=factor, staircase=True)

@@ -1,0 +1,43 @@
+"""LR schedules: shapes of the standard recipes."""
+
+import numpy as np
+import pytest
+
+from distributed_deep_learning_tpu.train.schedules import (step_decay,
+                                                           warmup_cosine,
+                                                           warmup_rsqrt)
+
+
+def test_warmup_cosine_shape():
+    sched = warmup_cosine(1.0, warmup_steps=10, total_steps=100)
+    assert float(sched(0)) == 0.0
+    np.testing.assert_allclose(float(sched(10)), 1.0, rtol=1e-6)
+    assert float(sched(55)) < 1.0
+    np.testing.assert_allclose(float(sched(100)), 0.0, atol=1e-6)
+    # monotone decay after the peak
+    vals = [float(sched(s)) for s in range(10, 101, 10)]
+    assert all(a >= b for a, b in zip(vals, vals[1:]))
+
+
+def test_warmup_cosine_validates():
+    with pytest.raises(ValueError):
+        warmup_cosine(1.0, warmup_steps=100, total_steps=50)
+
+
+def test_warmup_rsqrt_noam():
+    d = 512
+    sched = warmup_rsqrt(d, warmup_steps=4000)
+    # rises during warmup, peaks at warmup, then decays as step^-0.5
+    assert float(sched(100)) < float(sched(4000))
+    np.testing.assert_allclose(float(sched(4000)),
+                               d ** -0.5 * 4000 ** -0.5, rtol=1e-5)
+    np.testing.assert_allclose(float(sched(16000)),
+                               d ** -0.5 * 16000 ** -0.5, rtol=1e-5)
+
+
+def test_step_decay_matches_reference_steplr():
+    sched = step_decay(0.01, steps_per_drop=7, factor=0.1)
+    np.testing.assert_allclose(float(sched(0)), 0.01, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(6)), 0.01, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(7)), 0.001, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(14)), 0.0001, rtol=1e-6)

@@ -54,6 +54,8 @@ def test_mlp_dp_learns(mesh8, capsys):
     assert '"train epoch 1 begins at ' in out
     assert ' with accuracy ' in out and ' and loss ' in out
     assert '"test ends at ' in out
+    # beyond-reference observability: per-phase throughput counters
+    assert '"metrics phase=train epoch=1 examples_per_sec=' in out
 
 
 def test_dp_matches_single_device_numerics(mesh8):
