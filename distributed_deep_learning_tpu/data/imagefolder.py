@@ -14,6 +14,7 @@ sharded :class:`..loader.DeviceLoader`.
 from __future__ import annotations
 
 import os
+import threading
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 
@@ -52,29 +53,34 @@ class ImageFolderDataset:
         self._pool = ThreadPoolExecutor(max(1, num_workers)) \
             if num_workers > 1 else None
         self._cache: OrderedDict[str, np.ndarray] = OrderedDict()
+        self._cache_lock = threading.Lock()  # decode threads share the LRU
         self._max_cached = max_cached_images
 
     def __len__(self) -> int:
         return len(self.samples)
 
     def _decode(self, path: str) -> np.ndarray:
-        img = self._cache.get(path)
-        if img is not None:
-            self._cache.move_to_end(path)
-            return img
+        with self._cache_lock:
+            img = self._cache.get(path)
+            if img is not None:
+                self._cache.move_to_end(path)
+                return img
         from PIL import Image
 
         from distributed_deep_learning_tpu import native
 
+        # decode outside the lock (PIL releases the GIL; a rare duplicate
+        # decode of the same path is cheaper than serialising the pool)
         with Image.open(path) as im:
             raw = np.asarray(im.convert("RGB"), dtype=np.float32)
         h, w = raw.shape[:2]
         img = native.crop_resize_bilinear(np.ascontiguousarray(raw), 0, 0,
                                           h, w, self.image_size,
                                           self.image_size)
-        self._cache[path] = img
-        while len(self._cache) > self._max_cached:
-            self._cache.popitem(last=False)
+        with self._cache_lock:
+            self._cache[path] = img
+            while len(self._cache) > self._max_cached:
+                self._cache.popitem(last=False)
         return img
 
     def item(self, index: int) -> tuple[np.ndarray, np.ndarray]:

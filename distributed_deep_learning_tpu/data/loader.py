@@ -107,7 +107,7 @@ class PrefetchLoader:
 
     def __init__(self, loader, depth: int = 2):
         self.loader = loader
-        self.depth = depth
+        self.depth = max(1, int(depth))
 
     def __len__(self) -> int:
         return len(self.loader)
@@ -122,33 +122,59 @@ class PrefetchLoader:
 
         q: "queue.Queue" = queue.Queue(maxsize=self.depth)
         _END = object()
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            # bounded-wait put so an abandoned consumer (early `break` from
+            # the epoch loop) never strands the producer on a full queue
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def produce():
             try:
                 for item in self.loader:
-                    q.put(item)
-                q.put(_END)
+                    if not put(item):
+                        return
+                put(_END)
             except BaseException as e:  # surface in the consumer
-                q.put(e)
+                put(e)
 
         t = threading.Thread(target=produce, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is _END:
-                break
-            if isinstance(item, BaseException):
-                raise item
-            yield item
-        t.join()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            while True:  # unblock a producer mid-put
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=5.0)
 
 
 def make_loaders(dataset: ArrayDataset, splits, global_batch_size: int,
-                 mesh: Mesh, seed: int = 42) -> tuple[DeviceLoader, DeviceLoader, DeviceLoader]:
+                 mesh: Mesh, seed: int = 42, prefetch: int = 2):
     """(train, val, test) loaders with reference semantics: train shuffles
-    per-epoch, eval splits iterate in fixed order."""
+    per-epoch, eval splits iterate in fixed order.  The train loader is
+    wrapped in :class:`PrefetchLoader` (``prefetch`` batches deep, 0 to
+    disable) so host batch formation overlaps device compute — the analogue
+    of the reference's DataLoader worker processes."""
     train = DeviceLoader(dataset, splits.train, global_batch_size, mesh,
                          shuffle=True, seed=seed)
+    if prefetch:
+        train = PrefetchLoader(train, depth=prefetch)
     val = DeviceLoader(dataset, splits.val, global_batch_size, mesh,
                        shuffle=False, seed=seed)
     test = DeviceLoader(dataset, splits.test, global_batch_size, mesh,

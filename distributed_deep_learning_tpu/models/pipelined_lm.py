@@ -1,0 +1,119 @@
+"""CLI-facing pipelined language models: embed → SPMD trunk → head.
+
+This is the model the ``transformer``/``bert`` workloads build for
+``-m pipeline``: the homogeneous transformer trunk runs through
+:class:`..parallel.pipeline_transformer.PipelinedTrunk` (one XLA program,
+``stage`` mesh axis, forward AND backward pipelined — unlike the
+reference's forward-only scheduler, ``src/pytorch/MLP/model.py:81-130``),
+while the heterogeneous ends (embedding, LM head) run outside the pipeline
+with ordinary shardings.
+
+Design notes (documented divergences, both TPU-first):
+
+* SPMD pipelining requires a homogeneous stack, so the ``transformer``
+  workload's pipeline mode trains a *decoder-only* causal LM over the
+  concatenated source⊕target token stream, reading logits at the target
+  positions — the modern pipeline-friendly formulation of seq2seq; the
+  encoder-decoder form stays available in ``-m data``.
+* The head is untied (no weight sharing with the embedding): a tied head
+  would have to reference embedding parameters across the pipeline
+  boundary, forcing an extra gather per step.
+* The trunk is deterministic (dropout 0 inside the pipeline); ``--dropout``
+  therefore only rejects, never silently degrades.
+
+The object is not an ``nn.Module``: it owns three Flax sub-models and
+exposes the package's ``TrainState`` calling convention directly
+(``apply_fn(params, model_state, x, train, rngs)``), with a sharding-rule
+table (``shard_rules``) that puts the stacked trunk parameters on the
+``stage`` axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_deep_learning_tpu.parallel.pipeline_transformer import (
+    PipelinedTrunk)
+
+
+class LMEmbed(nn.Module):
+    """Token + learned positional embedding (ignores ``train``)."""
+
+    vocab_size: int
+    d_model: int
+    max_len: int = 4096
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        x = nn.Embed(self.vocab_size, self.d_model,
+                     embedding_init=nn.initializers.normal(0.02),
+                     dtype=self.dtype, name="tok")(tokens)
+        pos = self.param("pos", nn.initializers.normal(0.02),
+                         (self.max_len, self.d_model))
+        return x + pos[None, :tokens.shape[1]].astype(self.dtype)
+
+
+class LMHead(nn.Module):
+    """Vocabulary projection, f32 logits; optionally reads only a static
+    slice of positions (the target segment of a src⊕tgt stream)."""
+
+    vocab_size: int
+    take: Optional[tuple[int, int]] = None  # (start, length) or None = all
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.take is not None:
+            start, length = self.take
+            x = x[:, start:start + length]
+        x = nn.Dense(self.vocab_size, dtype=self.dtype,
+                     kernel_init=nn.initializers.xavier_uniform())(x)
+        return x.astype(jnp.float32)
+
+
+class PipelinedLM:
+    """embed → pipelined trunk → head with ``TrainState`` conventions."""
+
+    #: params whose leading (stacked-stage) axis lives on ``stage``
+    shard_rules = ((r"^trunk/.*", P("stage")),)
+
+    def __init__(self, *, vocab_size: int, num_layers: int, d_model: int,
+                 num_heads: int, mlp_dim: int, mesh: Mesh,
+                 causal: bool = False,
+                 head_take: Optional[tuple[int, int]] = None,
+                 microbatch_size: Optional[int] = None,
+                 max_len: int = 4096, dtype: jnp.dtype = jnp.float32):
+        self.embed = LMEmbed(vocab_size, d_model, max_len, dtype)
+        self.trunk = PipelinedTrunk(num_layers, mesh, num_heads=num_heads,
+                                    mlp_dim=mlp_dim, causal=causal,
+                                    dtype=dtype,
+                                    microbatch_size=microbatch_size)
+        self.head = LMHead(vocab_size, head_take, dtype)
+
+    def init(self, rng: jax.Array, tokens: jnp.ndarray) -> dict[str, Any]:
+        r_embed, r_trunk, r_head = jax.random.split(rng, 3)
+        e = self.embed.init(r_embed, tokens)["params"]
+        x0 = self.embed.apply({"params": e}, tokens)
+        t = self.trunk.init(r_trunk, x0)
+        h = self.head.init(r_head, x0)["params"]
+        return {"embed": e, "trunk": t, "head": h}
+
+    def apply_fn(self, params, model_state, tokens, train: bool = False,
+                 rngs=None):
+        """→ (logits, model_state, aux) — the ``TrainState`` convention."""
+        x = self.embed.apply({"params": params["embed"]}, tokens)
+        x = self.trunk.apply(params["trunk"], x)
+        logits = self.head.apply({"params": params["head"]}, x)
+        return logits, model_state, jnp.zeros((), jnp.float32)
+
+    def apply_sequential(self, params, tokens, train: bool = False):
+        """Same weights without the pipeline (equivalence testing)."""
+        x = self.embed.apply({"params": params["embed"]}, tokens)
+        x = self.trunk.apply_sequential(params["trunk"], x)
+        return self.head.apply({"params": params["head"]}, x)

@@ -136,6 +136,61 @@ class ResNet(nn.Module):
         return x.astype(jnp.float32)
 
 
+class ResNetStem(nn.Module):
+    """The input stem as a standalone stage layer (conv-BN-relu[-pool])."""
+
+    width: int = 64
+    small_inputs: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        if self.small_inputs:
+            x = nn.Conv(self.width, (3, 3), use_bias=False,
+                        kernel_init=conv_init, dtype=self.dtype)(x)
+        else:
+            x = nn.Conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                        use_bias=False, kernel_init=conv_init,
+                        dtype=self.dtype)(x)
+        x = _bn(self.dtype)(x, use_running_average=not train)
+        x = nn.relu(x)
+        if not self.small_inputs:
+            x = nn.max_pool(x, (3, 3), (2, 2), padding=((1, 1), (1, 1)))
+        return x
+
+
+class ResNetHead(nn.Module):
+    """Global average pool + classifier as a standalone stage layer."""
+
+    num_classes: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     kernel_init=nn.initializers.variance_scaling(
+                         1.0, "fan_in", "truncated_normal"))(x)
+        return x.astype(jnp.float32)
+
+
+def resnet_layer_sequence(stage_sizes: Sequence[int] = (3, 4, 6, 3),
+                          block_cls: type = BottleneckBlock,
+                          num_classes: int = 1000, width: int = 64,
+                          small_inputs: bool = False,
+                          dtype: jnp.dtype = jnp.float32) -> list[nn.Module]:
+    """The same network as :class:`ResNet`, as a partitionable layer list
+    (stem, residual blocks, head) for the MPMD model/pipeline modes."""
+    layers: list[nn.Module] = [ResNetStem(width, small_inputs, dtype)]
+    for i, n_blocks in enumerate(stage_sizes):
+        for j in range(n_blocks):
+            strides = 2 if i > 0 and j == 0 else 1
+            layers.append(block_cls(width * 2 ** i, strides, dtype=dtype))
+    layers.append(ResNetHead(num_classes, dtype))
+    return layers
+
+
 def resnet18(**kw) -> ResNet:
     return ResNet(stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock, **kw)
 

@@ -140,6 +140,8 @@ class Config:
     checkpoint_dir: str | None = None
     resume: bool = False
     profile_dir: str | None = None
+    data_dir: str | None = None         # real-data root (ImageFolder layout)
+    image_size: int = 224               # decode size for --data-dir images
     distributed: DistributedEnv = dataclasses.field(default_factory=DistributedEnv)
 
     def replace(self, **kw) -> "Config":
@@ -225,6 +227,12 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir", type=str, default=None)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--profile-dir", type=str, default=None)
+    p.add_argument("--data-dir", type=str, default=None,
+                   help="train on a real ImageFolder-layout dataset "
+                        "(root/<class>/*.jpg) instead of the synthetic twin; "
+                        "-w sets the decode thread count")
+    p.add_argument("--image-size", type=int, default=224,
+                   help="square decode size for --data-dir images")
     return p
 
 
@@ -268,5 +276,7 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         checkpoint_dir=args.checkpoint_dir,
         resume=args.resume,
         profile_dir=args.profile_dir,
+        data_dir=args.data_dir,
+        image_size=args.image_size,
         distributed=dist,
     )

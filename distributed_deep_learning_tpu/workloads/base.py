@@ -72,6 +72,10 @@ class WorkloadSpec:
     example_input: Callable[[Config, Any], jnp.ndarray]
     # optional: tensor-parallel sharding rules (enables --mesh model=K)
     tp_rules: Callable[[Config], Any] | None = None
+    # optional: (config, dataset, mesh) -> PipelinedLM-like model; when set,
+    # `-m pipeline` runs the SPMD pipeline (stage mesh axis, one XLA
+    # program) instead of MPMD staging
+    build_pipelined: Callable[[Config, Any, Any], Any] | None = None
 
 
 def config_dtype(config: Config) -> jnp.dtype:
@@ -241,6 +245,77 @@ def _maybe_checkpointer(config: Config):
     return ckpt, (last + 1 if last is not None else 1)
 
 
+def _run_spmd_pipelined(spec: WorkloadSpec, config: Config, devices, logger,
+                        dataset, splits, example, loss_fn, tx, rng
+                        ) -> tuple[Any, list[EpochResult]]:
+    """`-m pipeline` over the SPMD `stage` axis: one jitted step, stacked
+    stage params sharded over `stage`, activations rotated with ppermute —
+    replaces MPMD staging for workloads that declare ``build_pipelined``.
+
+    Composes with data parallelism: leftover devices form the `data` axis,
+    so ``--nstages 4`` on 8 devices runs a 2-way-DP 4-stage pipeline.
+    """
+    from distributed_deep_learning_tpu.parallel.tensor_parallel import (
+        tp_state_spec)
+    from distributed_deep_learning_tpu.train.state import TrainState
+
+    n_dev = len(devices)
+    n_layers = config.num_layers
+    if config.num_stages:
+        n_stages = config.num_stages
+    else:
+        # largest stage count that divides both the trunk depth and the
+        # device count (so the remainder forms a whole `data` axis)
+        n_stages = max((s for s in range(1, n_dev + 1)
+                        if n_layers % s == 0 and n_dev % s == 0), default=1)
+    if n_stages > n_dev:
+        raise ValueError(f"--nstages {n_stages} exceeds {n_dev} devices")
+    if n_dev % n_stages:
+        raise ValueError(f"--nstages {n_stages} must divide the device "
+                         f"count {n_dev} (the rest becomes the data axis)")
+    if config.dropout > 0:
+        raise ValueError("pipeline mode trains a deterministic trunk; "
+                         "--dropout is not supported here (use -m data)")
+    dp = n_dev // n_stages
+    mesh = build_mesh({"data": dp, "stage": n_stages},
+                      devices[:dp * n_stages])
+    logger.info(f"SPMD pipeline: {n_stages} stages x {dp}-way data parallel")
+
+    # the microbatch (reference -p SIZE) must divide the global batch and be
+    # divisible by the data-parallel degree; snap to the nearest valid size
+    # (B itself is always valid: the loader guarantees B % dp == 0)
+    B, mb = config.batch_size, config.microbatch or dp
+    if mb % dp or B % mb:
+        valid = [d for d in range(dp, B + 1, dp) if B % d == 0]
+        snapped = min(valid, key=lambda d: (abs(d - mb), d))
+        logger.info(f"microbatch {mb} incompatible with batch {B} / "
+                    f"dp {dp}; using {snapped}")
+        config = config.replace(microbatch=snapped)
+
+    model = spec.build_pipelined(config, dataset, mesh)
+    state = TrainState.create(apply_fn=model.apply_fn,
+                              params=model.init(rng, example), tx=tx)
+    state_spec = tp_state_spec(state, model.shard_rules)
+    state = place_state(state, mesh, state_spec)
+    train_step, eval_step = make_step_fns(mesh, loss_fn,
+                                          state_spec=state_spec,
+                                          remat=config.remat)
+    loaders = make_loaders(dataset, splits, config.batch_size, mesh,
+                           seed=config.seed)
+    ckpt, start_epoch = _maybe_checkpointer(config)
+    if ckpt is not None and start_epoch > 1:
+        state = ckpt.restore(state) or state
+        logger.info(f"resumed from epoch {start_epoch - 1}")
+    try:
+        with profiling.trace(config.profile_dir):
+            return fit(state, train_step, eval_step, *loaders,
+                       epochs=config.epochs, logger=logger,
+                       checkpointer=ckpt, start_epoch=start_epoch)
+    finally:
+        if ckpt is not None:
+            ckpt.close()
+
+
 # ---------------------------------------------------------------------------
 # The runner
 # ---------------------------------------------------------------------------
@@ -263,6 +338,10 @@ def run_workload(spec: WorkloadSpec, config: Config
     epoch_steps = max(1, len(splits.train) // config.batch_size)
     tx = spec.build_optimizer(config, epoch_steps)
     rng = jax.random.key(config.seed)
+
+    if config.mode is Mode.PIPELINE and spec.build_pipelined is not None:
+        return _run_spmd_pipelined(spec, config, devices, logger, dataset,
+                                   splits, example, loss_fn, tx, rng)
 
     if config.mode in (Mode.SEQUENTIAL, Mode.DATA):
         if config.mode is Mode.SEQUENTIAL:

@@ -10,14 +10,16 @@ workloads so one command line covers the whole model zoo::
 
 Flag mapping: ``-l`` = layer count (transformer/bert), ``-s`` = ResNet
 depth (18/34/50) or model width.  All run on synthetic shape-twins of the
-real datasets (``data.datasets``); the loaders' contract means pointing
-them at real data is a dataset-constructor swap.
+real datasets (``data.datasets``) unless ``--data-dir`` points at real
+files; the loaders' contract means pointing them at real data is a
+dataset-constructor swap.
 
-Model/pipeline (staged) modes are intentionally not offered here: these
-models parallelise better with the sharded-step paths (``-m data`` +
-``--zero`` + ``--mesh``), and their trunks pipeline via
-:func:`..parallel.spmd_pipeline.spmd_pipeline` (see
-``tests/test_pipeline_transformer.py``) rather than MPMD staging.
+Parallel modes: ``-m data`` (+ ``--zero`` / ``--mesh model=K``) is the
+primary path.  ``-m pipeline`` runs the SPMD pipeline for transformer/bert
+(``build_pipelined`` → :mod:`..models.pipelined_lm`: ``stage`` mesh axis,
+forward+backward in one XLA program) and MPMD staging for resnet;
+``-m model`` stages the layer sequences over explicit devices.  moe rejects
+staged modes (experts shard over the ``expert`` axis instead).
 """
 
 from __future__ import annotations
@@ -36,6 +38,7 @@ from distributed_deep_learning_tpu.models.resnet import (BasicBlock,
                                                          ResNet)
 from distributed_deep_learning_tpu.models.transformer import (BertEncoder,
                                                               TransformerSeq2Seq)
+from distributed_deep_learning_tpu.parallel.partition import balanced_partition
 from distributed_deep_learning_tpu.parallel.tensor_parallel import (
     transformer_tp_rules)
 from distributed_deep_learning_tpu.train.objectives import (
@@ -49,28 +52,56 @@ from distributed_deep_learning_tpu.workloads.base import (WorkloadSpec,
 _RESNET_LAYERS = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3), 50: (3, 4, 6, 3)}
 
 
-def _no_staging(config, dataset):
-    raise NotImplementedError(
-        "model/pipeline staging is not offered for north-star workloads; "
-        "use -m data with --zero/--mesh (or the SPMD pipeline API directly)")
-
-
 # --- resnet ----------------------------------------------------------------
 
-def _resnet_model(config: Config, dataset):
+def _resnet_dataset(config: Config):
+    """Real ImageFolder data when ``--data-dir`` is given (decode threads
+    driven by ``-w``), the synthetic CIFAR twin otherwise."""
+    if config.data_dir:
+        from distributed_deep_learning_tpu.data.imagefolder import (
+            ImageFolderDataset)
+
+        return ImageFolderDataset(config.data_dir,
+                                  image_size=config.image_size,
+                                  num_workers=config.num_workers or 8)
+    return synthetic_cifar10(seed=config.seed)
+
+
+def _resnet_geometry(config: Config, dataset):
     depth = config.size if config.size in _RESNET_LAYERS else 18
+    num_classes = len(getattr(dataset, "classes", ())) or 10
+    # ImageFolder decode size decides the stem: small inputs (<=64 px, the
+    # CIFAR twin included) use the 3x3-s1 stem, ImageNet-size the 7x7-s2
+    small = config.image_size <= 64 if config.data_dir else True
+    return depth, num_classes, small
+
+
+def _resnet_model(config: Config, dataset):
+    depth, num_classes, small = _resnet_geometry(config, dataset)
     return ResNet(stage_sizes=_RESNET_LAYERS[depth],
                   block_cls=BottleneckBlock if depth >= 50 else BasicBlock,
-                  num_classes=10, small_inputs=True,
+                  num_classes=num_classes, small_inputs=small,
                   dtype=config_dtype(config))
+
+
+def _resnet_layers(config: Config, dataset):
+    from distributed_deep_learning_tpu.models.resnet import (
+        resnet_layer_sequence)
+
+    depth, num_classes, small = _resnet_geometry(config, dataset)
+    return resnet_layer_sequence(
+        stage_sizes=_RESNET_LAYERS[depth],
+        block_cls=BottleneckBlock if depth >= 50 else BasicBlock,
+        num_classes=num_classes, width=64, small_inputs=small,
+        dtype=config_dtype(config))
 
 
 RESNET_SPEC = WorkloadSpec(
     name="resnet",
-    build_dataset=lambda c: synthetic_cifar10(seed=c.seed),
+    build_dataset=_resnet_dataset,
     build_model=_resnet_model,
-    build_layers=_no_staging,
-    partitioner=lambda n, s: np.zeros(n, np.int64),
+    build_layers=_resnet_layers,
+    partitioner=balanced_partition,
     build_loss=lambda c: cross_entropy_loss,
     build_optimizer=lambda c, steps: optax.sgd(
         c.learning_rate if c.learning_rate != 1e-3 else 0.1, momentum=0.9),
@@ -115,17 +146,66 @@ def _transformer_model(config: Config, dataset):
     return Seq2SeqAdapter(inner, src_len)
 
 
+def _lm_geometry(config: Config, dataset):
+    """(d_model, heads, mlp_dim, src_len, tgt_len) for the LM variants."""
+    d = config.size
+    tgt_len = dataset.targets.shape[1]
+    src_len = dataset.features.shape[1] - tgt_len
+    return d, max(2, d // 64), 4 * d, src_len, tgt_len
+
+
+def _transformer_pipelined(config: Config, dataset, mesh):
+    """``-m pipeline``: decoder-only causal LM over src⊕tgt tokens, logits
+    read at the target positions (see :mod:`..models.pipelined_lm` for the
+    divergence rationale — SPMD pipelining needs a homogeneous trunk)."""
+    from distributed_deep_learning_tpu.models.pipelined_lm import PipelinedLM
+
+    d, heads, mlp, src_len, tgt_len = _lm_geometry(config, dataset)
+    return PipelinedLM(vocab_size=1024, num_layers=config.num_layers,
+                       d_model=d, num_heads=heads, mlp_dim=mlp, mesh=mesh,
+                       causal=True, head_take=(src_len - 1, tgt_len),
+                       microbatch_size=config.microbatch,
+                       dtype=config_dtype(config))
+
+
+def _reject_staged_dropout(config: Config) -> None:
+    # staged trunks are deterministic (same contract as -m pipeline);
+    # silently training with rate 0 would diverge from -m data
+    if config.dropout > 0:
+        raise ValueError("staged modes train a deterministic trunk; "
+                         "--dropout is not supported here (use -m data)")
+
+
+def _transformer_layers(config: Config, dataset):
+    """``-m model``: the same decoder-only LM as a partitionable layer list
+    (embed / causal blocks / sliced head) for MPMD staging."""
+    from distributed_deep_learning_tpu.models.pipelined_lm import (LMEmbed,
+                                                                   LMHead)
+    from distributed_deep_learning_tpu.models.transformer import (
+        TransformerLayer)
+
+    _reject_staged_dropout(config)
+    d, heads, mlp, src_len, tgt_len = _lm_geometry(config, dataset)
+    dtype = config_dtype(config)
+    return [LMEmbed(1024, d, dtype=dtype)] + [
+        TransformerLayer(heads, mlp, dropout_rate=0.0, causal=True,
+                         dtype=dtype)
+        for _ in range(config.num_layers)
+    ] + [LMHead(1024, take=(src_len - 1, tgt_len), dtype=dtype)]
+
+
 TRANSFORMER_SPEC = WorkloadSpec(
     name="transformer",
     build_dataset=_wmt_dataset,
     build_model=_transformer_model,
-    build_layers=_no_staging,
-    partitioner=lambda n, s: np.zeros(n, np.int64),
+    build_layers=_transformer_layers,
+    partitioner=balanced_partition,
     build_loss=lambda c: token_cross_entropy,
     build_optimizer=lambda c, steps: optax.adamw(c.learning_rate),
     example_input=lambda c, ds: jnp.zeros((1, ds.features.shape[1]),
                                           jnp.int32),
     tp_rules=lambda c: transformer_tp_rules(),
+    build_pipelined=_transformer_pipelined,
 )
 
 
@@ -148,17 +228,47 @@ def _bert_model(config: Config, dataset):
                        dtype=config_dtype(config))
 
 
+def _bert_pipelined(config: Config, dataset, mesh):
+    """``-m pipeline``: bidirectional trunk + untied MLM head over the
+    ``stage`` axis (the full BertEncoder's tied head stays in ``-m data``)."""
+    from distributed_deep_learning_tpu.models.pipelined_lm import PipelinedLM
+
+    d = config.size
+    return PipelinedLM(vocab_size=1024, num_layers=config.num_layers,
+                       d_model=d, num_heads=max(2, d // 64), mlp_dim=4 * d,
+                       mesh=mesh, causal=False,
+                       microbatch_size=config.microbatch,
+                       dtype=config_dtype(config))
+
+
+def _bert_layers(config: Config, dataset):
+    from distributed_deep_learning_tpu.models.pipelined_lm import (LMEmbed,
+                                                                   LMHead)
+    from distributed_deep_learning_tpu.models.transformer import (
+        TransformerLayer)
+
+    _reject_staged_dropout(config)
+    d = config.size
+    dtype = config_dtype(config)
+    return [LMEmbed(1024, d, dtype=dtype)] + [
+        TransformerLayer(max(2, d // 64), 4 * d, dropout_rate=0.0,
+                         dtype=dtype)
+        for _ in range(config.num_layers)
+    ] + [LMHead(1024, dtype=dtype)]
+
+
 BERT_SPEC = WorkloadSpec(
     name="bert",
     build_dataset=_mlm_dataset,
     build_model=_bert_model,
-    build_layers=_no_staging,
-    partitioner=lambda n, s: np.zeros(n, np.int64),
+    build_layers=_bert_layers,
+    partitioner=balanced_partition,
     build_loss=lambda c: token_cross_entropy,
     build_optimizer=lambda c, steps: optax.adamw(c.learning_rate),
     example_input=lambda c, ds: jnp.zeros((1, ds.features.shape[1]),
                                           jnp.int32),
     tp_rules=lambda c: transformer_tp_rules(),
+    build_pipelined=_bert_pipelined,
 )
 
 # --- moe (sparse-expert MLM) -----------------------------------------------
@@ -181,11 +291,18 @@ def _moe_rules(config: Config):
     return moe_param_rules()
 
 
+def _moe_no_staging(config, dataset):
+    raise ValueError(
+        "moe parallelises over experts, not stages: use -m data with "
+        "--mesh expert=K (staged modes would drop the router's "
+        "load-balance aux loss)")
+
+
 MOE_SPEC = WorkloadSpec(
     name="moe",
     build_dataset=_mlm_dataset,
     build_model=_moe_model,
-    build_layers=_no_staging,
+    build_layers=_moe_no_staging,
     partitioner=lambda n, s: np.zeros(n, np.int64),
     build_loss=lambda c: token_cross_entropy,
     build_optimizer=lambda c, steps: optax.adamw(c.learning_rate),

@@ -1,0 +1,118 @@
+"""Driver-contract hooks: dryrun_multichip self-provisioning + bench fallback.
+
+The driver calls ``dryrun_multichip(n)`` from an environment with one real
+TPU chip; the hook must provision its own virtual n-device CPU platform
+(round-1/2 failure mode: it ran on the ambient 1-device platform and died
+in ``build_mesh``).  ``bench.py`` must print its JSON line even when the
+accelerator backend fails to init (round-1 failure mode: rc=1).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+
+import __graft_entry__ as hooks
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_with_device_count_appends():
+    assert hooks._with_device_count("", 8) == \
+        "--xla_force_host_platform_device_count=8"
+
+
+def test_with_device_count_replaces_existing():
+    out = hooks._with_device_count(
+        "--foo --xla_force_host_platform_device_count=2 --bar", 8)
+    assert "device_count=8" in out
+    assert "device_count=2" not in out
+    assert "--foo" in out and "--bar" in out
+
+
+def test_ensure_virtual_devices_enough_already():
+    # conftest forces 8 CPU devices; asking for <= 8 needs no re-exec
+    assert hooks._ensure_virtual_devices(8) is True
+    assert hooks._ensure_virtual_devices(4) is True
+
+
+def test_ensure_virtual_devices_too_many_signals_subprocess():
+    # jax is initialised with 8 devices here; 16 requires a re-exec
+    assert hooks._ensure_virtual_devices(16) is False
+
+
+def test_dryrun_multichip_subprocess_path(monkeypatch):
+    # With jax bound to 8 devices, dryrun_multichip(16) must take the
+    # subprocess branch with a forced-CPU 16-device environment.
+    calls = {}
+
+    def fake_run(cmd, env=None, **kw):
+        calls["cmd"], calls["env"] = cmd, env
+
+        class R:
+            returncode = 0
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    hooks.dryrun_multichip(16)
+    assert calls["cmd"][1].endswith("__graft_entry__.py")
+    assert calls["cmd"][2:] == ["--dryrun", "16"]
+    assert calls["env"]["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=16" in \
+        calls["env"]["XLA_FLAGS"]
+
+
+def test_dryrun_multichip_subprocess_failure_raises(monkeypatch):
+    def fake_run(cmd, env=None, **kw):
+        class R:
+            returncode = 3
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    try:
+        hooks.dryrun_multichip(16)
+    except RuntimeError as exc:
+        assert "rc=3" in str(exc)
+    else:
+        raise AssertionError("expected RuntimeError on child failure")
+
+
+def test_bench_fallback_reexecs_on_cpu(monkeypatch):
+    sys.path.insert(0, REPO)
+    import bench
+
+    monkeypatch.delenv("BENCH_CPU_FALLBACK", raising=False)
+    monkeypatch.setattr(jax, "devices",
+                        lambda *a: (_ for _ in ()).throw(RuntimeError("boom")))
+    captured = {}
+
+    def fake_call(cmd, env=None, **kw):
+        captured["cmd"], captured["env"] = cmd, env
+        return 0
+
+    monkeypatch.setattr(subprocess, "call", fake_call)
+    try:
+        bench._devices_or_cpu_fallback()
+    except SystemExit as exc:
+        assert exc.code == 0
+    else:
+        raise AssertionError("expected SystemExit from fallback re-exec")
+    assert captured["env"]["JAX_PLATFORMS"] == "cpu"
+    assert captured["env"]["BENCH_CPU_FALLBACK"] == "1"
+    assert captured["cmd"][1].endswith("bench.py")
+
+
+def test_bench_fallback_no_recursion(monkeypatch):
+    import bench
+
+    monkeypatch.setenv("BENCH_CPU_FALLBACK", "1")
+    monkeypatch.setattr(jax, "devices",
+                        lambda *a: (_ for _ in ()).throw(RuntimeError("boom")))
+    try:
+        bench._devices_or_cpu_fallback()
+    except RuntimeError as exc:
+        assert "boom" in str(exc)
+    else:
+        raise AssertionError("second-level failure must re-raise, not loop")

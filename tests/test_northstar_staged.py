@@ -1,0 +1,137 @@
+"""North-star `-m pipeline` (SPMD PipelinedTrunk) and `-m model` (MPMD)
+CLI paths — the reference offers model/pipeline modes for every workload
+(``src/pytorch/CNN/model.py:206-255``); here transformer/bert pipeline over
+the ``stage`` mesh axis and resnet stages MPMD-style."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_deep_learning_tpu.utils.config import Config, Mode
+from distributed_deep_learning_tpu.workloads.base import run_workload
+from distributed_deep_learning_tpu.workloads.northstar import (BERT_SPEC,
+                                                               MOE_SPEC,
+                                                               RESNET_SPEC,
+                                                               TRANSFORMER_SPEC)
+
+
+def _phases(history):
+    return [h.phase for h in history]
+
+
+def test_bert_pipeline_mode_trains(monkeypatch):
+    monkeypatch.setenv("DDL_DATA_LIMIT", "64")
+    config = Config(mode=Mode.PIPELINE, num_layers=4, size=32, epochs=1,
+                    batch_size=16, num_stages=4, microbatch=4)
+    state, history = run_workload(BERT_SPEC, config)
+    assert "train" in _phases(history) and "test" in _phases(history)
+    # stacked trunk params exist and carry the stage-leading axis
+    trunk = state.params["trunk"]
+    import jax
+    leaves = jax.tree.leaves(trunk)
+    assert all(l.shape[0] == 4 for l in leaves)
+    assert np.isfinite(history[0].loss)
+
+
+def test_bert_pipeline_composes_data_parallel(monkeypatch):
+    """--nstages 4 on 8 devices → 2-way DP x 4-stage pipeline."""
+    monkeypatch.setenv("DDL_DATA_LIMIT", "64")
+    config = Config(mode=Mode.PIPELINE, num_layers=4, size=32, epochs=1,
+                    batch_size=16, num_stages=4, microbatch=4)
+    _, history = run_workload(BERT_SPEC, config)
+    train = [h for h in history if h.phase == "train"][0]
+    assert train.examples > 0
+
+
+def test_transformer_pipeline_mode_trains(monkeypatch):
+    monkeypatch.setenv("DDL_DATA_LIMIT", "64")
+    config = Config(mode=Mode.PIPELINE, num_layers=2, size=32, epochs=1,
+                    batch_size=16, num_stages=2, microbatch=8)
+    _, history = run_workload(TRANSFORMER_SPEC, config)
+    assert "train" in _phases(history)
+    assert np.isfinite(history[0].loss)
+
+
+def test_pipeline_learning_progress(monkeypatch):
+    """Two epochs of the pipelined bert must reduce training loss."""
+    monkeypatch.setenv("DDL_DATA_LIMIT", "96")
+    config = Config(mode=Mode.PIPELINE, num_layers=2, size=32, epochs=3,
+                    batch_size=16, num_stages=2, microbatch=8,
+                    learning_rate=1e-2)
+    _, history = run_workload(BERT_SPEC, config)
+    train_losses = [h.loss for h in history if h.phase == "train"]
+    assert train_losses[-1] < train_losses[0]
+
+
+def test_pipeline_rejects_dropout(monkeypatch):
+    monkeypatch.setenv("DDL_DATA_LIMIT", "32")
+    config = Config(mode=Mode.PIPELINE, num_layers=2, size=32, epochs=1,
+                    batch_size=16, num_stages=2, dropout=0.1)
+    with pytest.raises(ValueError, match="dropout"):
+        run_workload(BERT_SPEC, config)
+
+
+def test_pipeline_snaps_incompatible_microbatch(monkeypatch):
+    """-p sizes that don't divide batch / data-parallel degree are snapped
+    to the nearest valid size instead of crashing in spmd_pipeline."""
+    monkeypatch.setenv("DDL_DATA_LIMIT", "64")
+    config = Config(mode=Mode.PIPELINE, num_layers=2, size=32, epochs=1,
+                    batch_size=16, num_stages=2, microbatch=3)  # dp=4
+    _, history = run_workload(BERT_SPEC, config)
+    assert "train" in _phases(history)
+
+
+def test_model_mode_rejects_dropout(monkeypatch):
+    monkeypatch.setenv("DDL_DATA_LIMIT", "32")
+    config = Config(mode=Mode.MODEL, num_layers=2, size=32, epochs=1,
+                    batch_size=8, dropout=0.1)
+    with pytest.raises(ValueError, match="dropout"):
+        run_workload(BERT_SPEC, config)
+
+
+def test_pipeline_rejects_bad_stage_count(monkeypatch):
+    monkeypatch.setenv("DDL_DATA_LIMIT", "32")
+    config = Config(mode=Mode.PIPELINE, num_layers=4, size=32, epochs=1,
+                    batch_size=16, num_stages=3)  # 3 does not divide 8
+    with pytest.raises(ValueError, match="nstages"):
+        run_workload(BERT_SPEC, config)
+
+
+def test_resnet_model_mode_stages(monkeypatch):
+    """resnet -m model: MPMD staging over the layer sequence."""
+    monkeypatch.setenv("DDL_DATA_LIMIT", "32")
+    config = Config(mode=Mode.MODEL, size=18, epochs=1, batch_size=8,
+                    num_stages=2)
+    _, history = run_workload(RESNET_SPEC, config)
+    assert "train" in _phases(history)
+    assert np.isfinite(history[0].loss)
+
+
+def test_moe_staged_mode_rejected(monkeypatch):
+    monkeypatch.setenv("DDL_DATA_LIMIT", "32")
+    config = Config(mode=Mode.MODEL, num_layers=2, size=32, epochs=1,
+                    batch_size=8)
+    with pytest.raises(ValueError, match="expert"):
+        run_workload(MOE_SPEC, config)
+
+
+def test_pipelined_lm_matches_sequential(mesh_4x2):
+    """The CLI model's pipelined forward == the same weights sequentially."""
+    import jax
+
+    from distributed_deep_learning_tpu.models.pipelined_lm import PipelinedLM
+    from distributed_deep_learning_tpu.runtime.mesh import build_mesh
+
+    mesh = build_mesh({"data": 2, "stage": 4})
+    model = PipelinedLM(vocab_size=64, num_layers=4, d_model=16, num_heads=2,
+                        mlp_dim=32, mesh=mesh, causal=True,
+                        head_take=(3, 4))
+    tokens = jax.random.randint(jax.random.key(0), (8, 8), 1, 64)
+    params = model.init(jax.random.key(1), tokens[:1])
+    expected = model.apply_sequential(params, tokens)
+    got, ms, aux = jax.jit(model.apply_fn, static_argnames="train")(
+        params, {}, tokens)
+    assert got.shape == (8, 4, 64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-4, atol=2e-5)
+    assert ms == {} and float(aux) == 0.0

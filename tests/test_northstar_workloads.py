@@ -72,11 +72,6 @@ def test_fsdp_runs():
     _ok(history)
 
 
-def test_staged_modes_rejected():
-    with pytest.raises(NotImplementedError):
-        _run("resnet", ["-e", "1", "-b", "32", "-m", "model"])
-
-
 def test_cli_defaults():
     c = parse_args([], workload="bert")
     assert c.num_layers == 12 and c.size == 768
