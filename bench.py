@@ -126,6 +126,41 @@ def _train_throughput(model, *, image_size, num_classes, batch, steps, mesh):
     return batch * steps / dt / n_chips, flops_per_step
 
 
+def _attention_speedup(steps: int = 20) -> float | None:
+    """Fused (Pallas flash) vs dense attention fwd+bwd at a long-context
+    shape; returns flash/dense step-time ratio > 1 = flash faster.  TPU
+    only (interpret mode on CPU measures nothing useful)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_deep_learning_tpu.models.transformer import (
+        dot_product_attention)
+    from distributed_deep_learning_tpu.ops.attention_pallas import (
+        flash_attention)
+
+    B, T, H, D = 4, 2048, 8, 64
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.bfloat16) for kk in ks)
+
+    def time_fn(fn):
+        loss = jax.jit(jax.grad(lambda q: jnp.sum(fn(q, k, v) ** 2)))
+        float(jnp.sum(loss(q)))  # compile + warm, host-fetch sync
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            g = loss(q)
+        float(jnp.sum(g))
+        return (time.perf_counter() - t0) / steps
+
+    try:
+        t_dense = time_fn(lambda q, k, v: dot_product_attention(
+            q, k, v, causal=True, dtype=jnp.bfloat16))
+        t_flash = time_fn(lambda q, k, v: flash_attention(
+            q, k, v, causal=True).astype(jnp.bfloat16))
+        return t_dense / t_flash
+    except Exception:
+        return None
+
+
 def _vs_baseline(baselines: dict, key: str, value: float,
                  base_path: str) -> float:
     if key not in baselines:
@@ -192,6 +227,10 @@ def main() -> None:
         secondary = {"metric": "densenet_bc64 train images/sec/chip",
                      "value": round(dips, 2), "vs_baseline": round(dvs, 4)}
 
+    attn_speedup = None
+    if on_tpu and os.environ.get("BENCH_ATTENTION", "1") != "0":
+        attn_speedup = _attention_speedup()
+
     print(json.dumps({
         "metric": f"resnet50_224 bf16 train images/sec/chip ({platform})",
         "value": round(ips, 2),
@@ -201,6 +240,8 @@ def main() -> None:
         "flops_per_image": round(flops_per_image) if flops_per_image else None,
         "device_kind": device_kind,
         "secondary": secondary,
+        "flash_attention_speedup":
+            round(attn_speedup, 3) if attn_speedup else None,
     }))
 
 

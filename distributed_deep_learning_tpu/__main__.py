@@ -20,6 +20,21 @@ def main(argv: list[str] | None = None) -> None:
               f"Run '<workload> -h' for the per-workload flag reference.")
         return
     name, rest = argv[0], argv[1:]
+    if "--spawn" in rest:
+        # reference -r semantics, process edition: fork -r local ranks that
+        # rendezvous via jax.distributed (CNN/main.py:202's
+        # torch.multiprocessing.spawn analogue; CPU — one chip can't be
+        # shared, pods launch ranks via the scheduler instead)
+        rest = [a for a in rest if a != "--spawn"]
+        from distributed_deep_learning_tpu.runtime.launch import launch_local
+        from distributed_deep_learning_tpu.utils.config import parse_args
+
+        n = parse_args(rest, workload=name).world_size
+        if n < 2:
+            raise SystemExit("--spawn needs -r N with N >= 2")
+        for res in launch_local(n, [name, *rest]):
+            sys.stdout.write(res.stdout)
+        return
     from distributed_deep_learning_tpu.utils.config import parse_args
     from distributed_deep_learning_tpu.workloads import get_spec, run_workload
 

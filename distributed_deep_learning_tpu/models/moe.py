@@ -141,15 +141,16 @@ class MoETransformerLayer(nn.Module):
     dropout_rate: float = 0.1
     dtype: jnp.dtype = jnp.float32
     aux_loss_weight: float = 1.0
+    attention_fn: object = None
 
     @nn.compact
-    def __call__(self, x, *, self_mask=None, train: bool = False):
+    def __call__(self, x, *, self_valid=None, train: bool = False):
         from distributed_deep_learning_tpu.models.transformer import (
             MultiHeadAttention)
 
         h = nn.LayerNorm(dtype=self.dtype)(x)
-        h = MultiHeadAttention(self.num_heads, self.dtype,
-                               name="self_attn")(h, h, self_mask)
+        h = MultiHeadAttention(self.num_heads, self.dtype, self.attention_fn,
+                               name="self_attn")(h, h, self_valid)
         h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
         x = x + h
         h = nn.LayerNorm(dtype=self.dtype)(x)
@@ -178,13 +179,14 @@ class MoELM(nn.Module):
     aux_loss_weight: float = 1e-2
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
+    attention_fn: object = None
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
         from distributed_deep_learning_tpu.models.transformer import (
             Embed, TransformerLayer)
 
-        pad = (tokens != 0)[:, None, None, :]
+        valid = tokens != 0  # (B, T)
         x, emb = Embed(self.vocab_size, self.d_model, dtype=self.dtype,
                        name="embed")(tokens)
         for i in range(self.num_layers):
@@ -192,12 +194,14 @@ class MoELM(nn.Module):
                 x = MoETransformerLayer(
                     self.num_heads, self.num_experts, self.mlp_dim,
                     self.capacity_factor, self.dropout_rate, self.dtype,
-                    self.aux_loss_weight, name=f"moe_layer_{i}")(
-                        x, self_mask=pad, train=train)
+                    self.aux_loss_weight, self.attention_fn,
+                    name=f"moe_layer_{i}")(
+                        x, self_valid=valid, train=train)
             else:
                 x = TransformerLayer(self.num_heads, self.mlp_dim,
                                      self.dropout_rate, dtype=self.dtype,
-                                     name=f"layer_{i}")(x, self_mask=pad,
+                                     attention_fn=self.attention_fn,
+                                     name=f"layer_{i}")(x, self_valid=valid,
                                                         train=train)
         x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
         return Embed.logits(x, emb)

@@ -27,10 +27,23 @@ AttentionFn = Callable[..., jnp.ndarray]
 dense_init = nn.initializers.xavier_uniform()
 
 
-def dot_product_attention(q, k, v, *, mask=None, dtype=jnp.float32):
-    """Plain softmax attention; q/k/v are (B, T, H, D)."""
+def dot_product_attention(q, k, v, *, mask=None, key_valid=None,
+                          causal=False, dtype=jnp.float32):
+    """Plain softmax attention; q/k/v are (B, T, H, D).
+
+    Masking follows the structured convention shared with the flash and
+    ring implementations: ``key_valid`` is a (B, Tk) boolean padding mask,
+    ``causal`` a flag; a pre-built dense ``mask`` (broadcastable to
+    (B, H, Tq, Tk)) is also accepted here and combined.
+    """
     depth = q.shape[-1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(depth)
+    if key_valid is not None:
+        kv = key_valid[:, None, None, :]
+        mask = kv if mask is None else jnp.logical_and(mask, kv)
+    if causal:
+        tril = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))[None, None]
+        mask = tril if mask is None else jnp.logical_and(mask, tril)
     if mask is not None:
         # -1e9, not finfo(f32).min: the latter overflows to -inf in bf16
         # (same exponent range, smaller mantissa → rounds past bf16 max) and
@@ -47,7 +60,8 @@ class MultiHeadAttention(nn.Module):
     attention_fn: Optional[AttentionFn] = None
 
     @nn.compact
-    def __call__(self, x_q, x_kv, mask=None):
+    def __call__(self, x_q, x_kv, key_valid=None, *, causal: bool = False,
+                 mask=None):
         d_model = x_q.shape[-1]
         head_dim = d_model // self.num_heads
         proj = lambda name: nn.DenseGeneral(  # noqa: E731
@@ -55,13 +69,19 @@ class MultiHeadAttention(nn.Module):
             kernel_init=dense_init, name=name)
         q, k, v = proj("q")(x_q), proj("k")(x_kv), proj("v")(x_kv)
         attn = self.attention_fn or dot_product_attention
-        y = attn(q, k, v, mask=mask, dtype=self.dtype)
+        y = attn(q, k, v, mask=mask, key_valid=key_valid, causal=causal,
+                 dtype=self.dtype)
         return nn.DenseGeneral(d_model, axis=(-2, -1), dtype=self.dtype,
                                kernel_init=dense_init, name="out")(y)
 
 
 class TransformerLayer(nn.Module):
-    """Pre-LN block: [self-attn] → [cross-attn]? → [MLP], residuals."""
+    """Pre-LN block: [self-attn] → [cross-attn]? → [MLP], residuals.
+
+    ``self_valid``/``cross_valid`` are (B, T) boolean padding masks handed
+    to the attention implementation in structured form (never as a dense
+    (T×T) tensor) so fused kernels can apply them in-block.
+    """
 
     num_heads: int = 8
     mlp_dim: int = 2048
@@ -72,23 +92,19 @@ class TransformerLayer(nn.Module):
     attention_fn: Optional[AttentionFn] = None
 
     @nn.compact
-    def __call__(self, x, encoded=None, *, self_mask=None, cross_mask=None,
+    def __call__(self, x, encoded=None, *, self_valid=None, cross_valid=None,
                  train: bool = False):
-        mask = self_mask
-        if self.causal:
-            T = x.shape[1]
-            causal = jnp.tril(jnp.ones((T, T), bool))[None, None]
-            mask = causal if mask is None else jnp.logical_and(mask, causal)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = MultiHeadAttention(self.num_heads, self.dtype, self.attention_fn,
-                               name="self_attn")(h, h, mask)
+                               name="self_attn")(h, h, self_valid,
+                                                 causal=self.causal)
         h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
         x = x + h
         if self.cross_attention:
             h = nn.LayerNorm(dtype=self.dtype)(x)
             h = MultiHeadAttention(self.num_heads, self.dtype,
                                    self.attention_fn,
-                                   name="cross_attn")(h, encoded, cross_mask)
+                                   name="cross_attn")(h, encoded, cross_valid)
             h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
             x = x + h
         h = nn.LayerNorm(dtype=self.dtype)(x)
@@ -147,8 +163,8 @@ class TransformerSeq2Seq(nn.Module):
     @nn.compact
     def __call__(self, batch, train: bool = False):
         inputs, targets = batch["inputs"], batch["targets"]
-        src_pad = (inputs != 0)[:, None, None, :]   # (B,1,1,S)
-        tgt_pad = (targets != 0)[:, None, None, :]  # (B,1,1,T)
+        src_valid = inputs != 0    # (B, S)
+        tgt_valid = targets != 0   # (B, T)
 
         # one shared-vocabulary embedding for source, target and the
         # (weight-tied) output projection — the transformer-base recipe
@@ -159,7 +175,7 @@ class TransformerSeq2Seq(nn.Module):
             x = TransformerLayer(self.num_heads, self.mlp_dim,
                                  self.dropout_rate, dtype=self.dtype,
                                  attention_fn=self.attention_fn,
-                                 name=f"enc_{i}")(x, self_mask=src_pad,
+                                 name=f"enc_{i}")(x, self_valid=src_valid,
                                                   train=train)
         encoded = nn.LayerNorm(dtype=self.dtype, name="enc_norm")(x)
 
@@ -172,8 +188,8 @@ class TransformerSeq2Seq(nn.Module):
                                  cross_attention=True, dtype=self.dtype,
                                  attention_fn=self.attention_fn,
                                  name=f"dec_{i}")(y, encoded,
-                                                  self_mask=tgt_pad,
-                                                  cross_mask=src_pad,
+                                                  self_valid=tgt_valid,
+                                                  cross_valid=src_valid,
                                                   train=train)
         y = nn.LayerNorm(dtype=self.dtype, name="dec_norm")(y)
         return Embed.logits(y, emb)
@@ -194,14 +210,14 @@ class BertEncoder(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
-        pad = (tokens != 0)[:, None, None, :]
+        valid = tokens != 0  # (B, T)
         x, emb = Embed(self.vocab_size, self.d_model, dtype=self.dtype,
                        name="embed")(tokens)
         for i in range(self.num_layers):
             x = TransformerLayer(self.num_heads, self.mlp_dim,
                                  self.dropout_rate, dtype=self.dtype,
                                  attention_fn=self.attention_fn,
-                                 name=f"layer_{i}")(x, self_mask=pad,
+                                 name=f"layer_{i}")(x, self_valid=valid,
                                                     train=train)
         x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
         # MLM head: dense + gelu + norm, weight-tied vocab projection

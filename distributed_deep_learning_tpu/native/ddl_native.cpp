@@ -17,6 +17,7 @@
 // is embarrassingly parallel over rows.
 
 #include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -104,6 +105,15 @@ int64_t count_cols(const char* line, const char* end) {
   return cols;
 }
 
+// A line is blank when it holds no non-whitespace character.  Blank lines
+// are not rows: the NumPy fallback (np.genfromtxt) skips them, and counting
+// them here would shift every subsequent row.
+bool is_blank_line(const char* p, const char* end) {
+  for (; p < end && *p != '\n'; ++p)
+    if (*p != ' ' && *p != '\t' && *p != '\r') return false;
+  return true;
+}
+
 }  // namespace
 
 // First pass: number of data rows and columns.  skip_header skips line 1.
@@ -119,11 +129,21 @@ int64_t ddl_csv_dims(const char* path, int32_t skip_header, int64_t* rows,
     if (p < end) ++p;
   }
   if (p >= end) return 2;
-  *cols = count_cols(p, end);
+  // column count comes from the first NON-BLANK data line (a leading blank
+  // line would report cols=1 and silently mangle the whole file)
+  const char* first = p;
+  while (first < end && is_blank_line(first, end)) {
+    while (first < end && *first != '\n') ++first;
+    if (first < end) ++first;
+  }
+  if (first >= end) return 2;
+  *cols = count_cols(first, end);
   int64_t n = 0;
-  for (const char* q = p; q < end; ++q)
-    if (*q == '\n') ++n;
-  if (end[-1] != '\n') ++n;  // unterminated last line
+  while (p < end) {
+    if (!is_blank_line(p, end)) ++n;
+    while (p < end && *p != '\n') ++p;
+    if (p < end) ++p;
+  }
   *rows = n;
   return 0;
 }
@@ -145,11 +165,12 @@ int64_t ddl_csv_parse(const char* path, int32_t skip_header,
   }
   const int64_t keep = cols - (drop_first_col ? 1 : 0);
 
-  // newline index so threads can jump to row boundaries
+  // newline index so threads can jump to row boundaries (blank lines are
+  // skipped — genfromtxt parity; see is_blank_line)
   std::vector<const char*> line_starts;
   line_starts.reserve(static_cast<size_t>(rows));
   for (const char* p = data_start; p < end;) {
-    line_starts.push_back(p);
+    if (!is_blank_line(p, end)) line_starts.push_back(p);
     while (p < end && *p != '\n') ++p;
     if (p < end) ++p;
   }
@@ -158,13 +179,21 @@ int64_t ddl_csv_parse(const char* path, int32_t skip_header,
   parallel_for(n, 4096, [&](int64_t begin, int64_t endrow) {
     for (int64_t r = begin; r < endrow; ++r) {
       const char* p = line_starts[static_cast<size_t>(r)];
+      const char* line_end = p;
+      while (line_end < end && *line_end != '\n') ++line_end;
       for (int64_t c = 0; c < cols; ++c) {
-        char* next = nullptr;
-        float v = std::strtof(p, &next);
-        if (next == p) v = 0.0f;  // empty/garbage field → 0
-        p = next;
-        while (p < end && *p != ',' && *p != '\n') ++p;
-        if (p < end && *p == ',') ++p;
+        // newline-bounded field parse: strtof skips leading whitespace
+        // INCLUDING '\n', so an empty/short field at end of line would
+        // otherwise read the next row's first value (row shift)
+        float v = 0.0f;
+        if (p < line_end && *p != ',') {
+          char* next = nullptr;
+          v = std::strtof(p, &next);
+          if (next == p || next > line_end) v = 0.0f;  // garbage / ran past
+        }
+        if (std::isnan(v)) v = 0.0f;  // fallback parity (nan_to_num)
+        while (p < line_end && *p != ',') ++p;
+        if (p < line_end && *p == ',') ++p;
         int64_t cc = c - (drop_first_col ? 1 : 0);
         if (cc >= 0 && cc < keep) out[r * keep + cc] = v;
       }

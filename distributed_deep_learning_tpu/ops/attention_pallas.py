@@ -36,8 +36,8 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
-                causal: bool, block_k: int, seq_len: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, kv_ref, o_ref, *, sm_scale: float,
+                causal: bool, block_k: int, k_len: int):
     q = q_ref[0].astype(jnp.float32)                 # (bq, D)
     bq, d = q.shape
     q_off = pl.program_id(1) * bq
@@ -57,6 +57,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
             k_pos = i * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if kv_ref is not None:
+            valid = kv_ref[0, pl.ds(i * block_k, block_k)]  # (block_k,) f32
+            s = jnp.where(valid[None, :] > 0, s, NEG_INF)
         blk_max = jnp.max(s, axis=-1, keepdims=True)
         new_m = jnp.maximum(m, blk_max)
         corr = jnp.exp(m - new_m)
@@ -66,104 +69,148 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
                                        preferred_element_type=jnp.float32)
         return new_m, new_l, new_acc
 
-    n_blocks = seq_len // block_k
+    n_blocks = k_len // block_k
     _, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    # all-keys-masked rows (fully-padded sequence) degrade to uniform
+    # attention, matching the dense path's -1e9 semantics — never NaN
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    BH, T, D = q.shape
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    if T % block_q or T % block_k:
-        raise ValueError(f"seq len {T} must divide block sizes "
-                         f"({block_q}, {block_k})")
-    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                               block_k=block_k, seq_len=T)
+def _fit_block(length: int, requested: int) -> int:
+    """Largest divisor of ``length`` not exceeding ``requested`` — block
+    sizes adapt to the data's sequence length (user-controlled via real
+    token files) instead of hard-failing on indivisible shapes."""
+    return max(b for b in range(1, min(requested, length) + 1)
+               if length % b == 0)
+
+
+def _flash_fwd(q, k, v, kvalid, sm_scale, causal, block_q, block_k,
+               interpret):
+    BH, Tq, D = q.shape
+    Tk = k.shape[1]
+    block_q = _fit_block(Tq, block_q)
+    block_k = _fit_block(Tk, block_k)
+    kernel = functools.partial(
+        _fwd_kernel if kvalid is not None else
+        lambda qr, kr, vr, orf, **kw: _fwd_kernel(qr, kr, vr, None, orf, **kw),
+        sm_scale=sm_scale, causal=causal, block_k=block_k, k_len=Tk)
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, qi: (b, qi, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, Tk, D), lambda b, qi: (b, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, Tk, D), lambda b, qi: (b, 0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [q, k, v]
+    if kvalid is not None:
+        in_specs.append(pl.BlockSpec((1, Tk), lambda b, qi: (b, 0),
+                                     memory_space=pltpu.VMEM))
+        args.append(kvalid)
     return pl.pallas_call(
         kernel,
-        grid=(BH, T // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, qi: (b, qi, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T, D), lambda b, qi: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T, D), lambda b, qi: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        grid=(BH, Tq // block_q),
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, qi: (b, qi, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
 
 
-def _dense_attention_bhtd(q, k, v, sm_scale, causal):
+def _dense_attention_bhtd(q, k, v, kvalid, sm_scale, causal):
     """(BH, T, D) dense reference used for the rematerialised backward."""
     s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * sm_scale
     if causal:
         T = q.shape[1]
         mask = jnp.tril(jnp.ones((T, T), bool))
         s = jnp.where(mask[None], s, NEG_INF)
+    if kvalid is not None:
+        s = jnp.where(kvalid[:, None, :] > 0, s, NEG_INF)
     w = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bqk,bkd->bqd", w, v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_bhtd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_bhtd(q, k, v, kvalid, sm_scale, causal, block_q, block_k,
+                interpret):
+    return _flash_fwd(q, k, v, kvalid, sm_scale, causal, block_q, block_k,
+                      interpret)
 
 
-def _flash_vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    out = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+def _flash_vjp_fwd(q, k, v, kvalid, sm_scale, causal, block_q, block_k,
+                   interpret):
+    out = _flash_fwd(q, k, v, kvalid, sm_scale, causal, block_q, block_k,
+                     interpret)
+    return out, (q, k, v, kvalid)
 
 
 def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
+    q, k, v, kvalid = res
     _, vjp = jax.vjp(
-        lambda q, k, v: _dense_attention_bhtd(q, k, v, sm_scale, causal),
+        lambda q, k, v: _dense_attention_bhtd(q, k, v, kvalid, sm_scale,
+                                              causal),
         q, k, v)
-    return vjp(g)
+    dq, dk, dv = vjp(g)
+    dkv = None if kvalid is None else jnp.zeros_like(kvalid)
+    return dq, dk, dv, dkv
 
 
 _flash_bhtd.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
-                    causal: bool = False, sm_scale: float | None = None,
+                    causal: bool = False, key_valid: jnp.ndarray | None = None,
+                    sm_scale: float | None = None,
                     block_q: int = 128, block_k: int = 128,
                     interpret: bool | None = None) -> jnp.ndarray:
     """Fused attention on ``(B, T, H, D)`` q/k/v (same layout as
     :func:`..models.transformer.dot_product_attention`).
 
-    ``interpret=None`` auto-selects: compiled on TPU, interpreter elsewhere
-    (so CPU tests exercise the identical kernel code).
+    ``key_valid`` is an optional ``(B, Tk)`` boolean padding mask (True =
+    attend); invalid keys are masked in-kernel with the same NEG_INF
+    semantics as the dense path.  ``interpret=None`` auto-selects: compiled
+    on TPU, interpreter elsewhere (so CPU tests exercise the identical
+    kernel code).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
-    B, T, H, D = q.shape
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
 
     def to_bhtd(x):
-        return jnp.swapaxes(x, 1, 2).reshape(B * H, T, D)
+        return jnp.swapaxes(x, 1, 2).reshape(B * x.shape[2], x.shape[1], D)
 
-    out = _flash_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v), sm_scale, causal,
-                      block_q, block_k, interpret)
-    return jnp.swapaxes(out.reshape(B, H, T, D), 1, 2)
+    kvalid = None
+    if key_valid is not None:
+        # per-batch mask, expanded over heads; float so the custom_vjp can
+        # hand back an ordinary zero cotangent
+        kvalid = jnp.repeat(key_valid.astype(jnp.float32), H, axis=0)
+    out = _flash_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v), kvalid, sm_scale,
+                      causal, block_q, block_k, interpret)
+    return jnp.swapaxes(out.reshape(B, H, Tq, D), 1, 2)
 
 
 def make_attention_fn(causal: bool = False, **kw):
     """Adapter: flash attention as a ``MultiHeadAttention.attention_fn``
-    (mirrors :func:`..parallel.ring_attention.make_attention_fn`)."""
+    (mirrors :func:`..parallel.ring_attention.make_attention_fn`).
 
-    def attn(q, k, v, *, mask=None, dtype=jnp.float32):
+    Supports the structured mask convention (``key_valid`` padding masks +
+    a ``causal`` flag); pre-built dense ``mask`` tensors are rejected —
+    materialising (T×T) masks is exactly what the kernel avoids.
+    """
+
+    forced_causal = causal
+
+    def attn(q, k, v, *, mask=None, key_valid=None, causal=False,
+             dtype=jnp.float32):
         if mask is not None:
             raise NotImplementedError(
-                "flash_attention computes its causal mask in-kernel; "
-                "explicit mask tensors are unsupported (pad-free batches or "
-                "the dense path instead)")
-        return flash_attention(q, k, v, causal=causal, **kw).astype(dtype)
+                "flash_attention takes key_valid/causal, not dense mask "
+                "tensors (pad-free batches or the dense path instead)")
+        return flash_attention(q, k, v, causal=causal or forced_causal,
+                               key_valid=key_valid, **kw).astype(dtype)
 
     return attn

@@ -74,11 +74,15 @@ class PipelinedTrunk:
             for i in range(self.n_stages)]
         return stack_stage_params(params)
 
+    def stage_fn(self):
+        """One stage's pure ``(params, x) -> y`` — the unit both pipeline
+        schedules (GPipe scan and 1F1B) apply per tick."""
+        return lambda p, a: self.stage.apply({"params": p}, a)
+
     def apply(self, stacked_params: Any, x: jnp.ndarray) -> jnp.ndarray:
         """(B, T, d) → (B, T, d) through all stages, pipelined."""
         return spmd_pipeline(
-            lambda p, a: self.stage.apply({"params": p}, a),
-            stacked_params, x, mesh=self.mesh,
+            self.stage_fn(), stacked_params, x, mesh=self.mesh,
             microbatch_size=self.microbatch_size)
 
     def apply_sequential(self, stacked_params: Any, x: jnp.ndarray

@@ -125,13 +125,17 @@ def make_attention_fn(mesh: Mesh, axis: str = "seq", causal: bool = False):
     boundaries instead.
     """
 
-    def attn(q, k, v, *, mask=None, dtype=jnp.float32):
-        if mask is not None:
+    forced_causal = causal
+
+    def attn(q, k, v, *, mask=None, key_valid=None, causal=False,
+             dtype=jnp.float32):
+        if mask is not None or key_valid is not None:
             raise NotImplementedError(
                 "ring attention computes its causal mask internally from "
                 "global positions; explicit mask tensors are unsupported "
-                "(set causal=True on make_attention_fn, not on the layer)")
-        out = ring_attention(q, k, v, mesh=mesh, axis=axis, causal=causal)
+                "(pad to block boundaries instead)")
+        out = ring_attention(q, k, v, mesh=mesh, axis=axis,
+                             causal=causal or forced_causal)
         return out.astype(dtype)
 
     return attn

@@ -115,3 +115,194 @@ def spmd_pipeline(stage_fn: StageFn, stacked_params: Any, x: jnp.ndarray, *,
 
     out = run(stacked_params, xs)
     return out.reshape(B, *out.shape[2:])
+
+
+def one_f_one_b_schedule(n_microbatches: int, n_stages: int
+                         ) -> list[tuple[int, int, str, int]]:
+    """The 1F1B tick table: ``(tick, stage, 'F'|'B', microbatch)`` entries.
+
+    Stage ``s`` forwards microbatch ``m`` at tick ``m + s`` and backwards it
+    at tick ``2(S-1) - s + m`` — the backward of microbatch m starts on the
+    last stage in the SAME tick as its forward there, then walks left.  Key
+    property vs GPipe-with-scan-transpose: microbatch m's residuals on
+    stage s live for only ``2(S-1-s)`` ticks, so peak activation residency
+    is O(S) instead of O(M) — which is what lets M grow (and the bubble
+    fraction (S-1)/(M+S-1) shrink) without running out of HBM.
+    Used by :func:`spmd_pipeline_1f1b` and analysed in tests.
+    """
+    M, S = n_microbatches, n_stages
+    ops = []
+    for t in range(M + 2 * S - 2):
+        for s in range(S):
+            if 0 <= t - s < M:
+                ops.append((t, s, "F", t - s))
+            if 0 <= t - (2 * S - 2 - s) < M:
+                ops.append((t, s, "B", t - (2 * S - 2 - s)))
+    return ops
+
+
+def spmd_pipeline_1f1b(stage_fn: StageFn, head_loss_fn, stacked_params: Any,
+                       head_params: Any, x: jnp.ndarray, targets: Any, *,
+                       mesh: Mesh, microbatch_size: int | None = None,
+                       axis: str = "stage",
+                       batch_axes: tuple[str, ...] = ("data", "fsdp"),
+                       has_aux: bool = False):
+    """One-forward-one-backward pipelined TRAIN pass in a single scan.
+
+    The GPipe path (:func:`spmd_pipeline` under ``jax.grad``) lets the scan
+    transpose replay the schedule in reverse, which stores every tick's
+    residuals — O(M) activations per stage.  Here forward AND backward are
+    hand-scheduled in one ``lax.scan`` (:func:`one_f_one_b_schedule`):
+    each tick a stage forwards one microbatch and backwards another, with a
+    ring buffer of just ``2S-1`` stage inputs and rematerialised block
+    backward (recompute-fwd + vjp, the standard TPU trade).
+
+    Because backward of microbatch m must start as soon as its forward
+    leaves the last stage, the loss must be computable there:
+    ``head_loss_fn(head_params, y_mb, target_mb) -> scalar`` (mean over the
+    microbatch rows) runs on the last stage inside the pipeline.
+
+    Returns ``(loss, trunk_grads, head_grads, dx)`` where ``loss`` is the
+    global mean, grads are already psum-reduced over the data axes (this
+    function hand-rolls its backward inside ``shard_map``, so the outer
+    autodiff/partitioner cannot insert those collectives), ``trunk_grads``
+    keeps the stacked stage-leading layout of ``stacked_params``, and
+    ``dx`` is the loss cotangent w.r.t. ``x`` (feeds the embedding's
+    backward in the caller).
+
+    With ``has_aux=True``, ``head_loss_fn`` returns ``(scalar, aux_tree)``
+    (e.g. correct/count metric counters); aux leaves are SUMMED over
+    microbatches and all mesh axes and appended as a fifth return value.
+    """
+    S = mesh.shape[axis]
+    B = x.shape[0]
+    if microbatch_size is None:
+        M = max(m for m in range(1, S + 1) if B % m == 0)
+        mb = B // M
+    else:
+        mb = microbatch_size
+        if B % mb:
+            raise ValueError(f"batch {B} not divisible by microbatch {mb}")
+        M = B // mb
+    dp_axes = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+    dp = 1
+    for a in dp_axes:
+        dp *= mesh.shape[a]
+    if mb % dp:
+        raise ValueError(f"microbatch size {mb} not divisible by "
+                         f"data-parallel size {dp}")
+    xs = x.reshape(M, mb, *x.shape[1:])
+    ts = jax.tree.map(lambda a: a.reshape(M, mb, *a.shape[1:]), targets)
+
+    R = 2 * S - 1           # residual ring slots (peak in-flight + 1)
+    T = M + 2 * S - 2       # total schedule ticks
+    scale = 1.0 / (M * dp)  # Σ microbatch-means → global mean
+
+    batch_spec = P(None, batch_axes)
+    param_spec = P(axis)
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(param_spec, P(), batch_spec, batch_spec),
+             out_specs=(P(), param_spec, P(), batch_spec, P()),
+             check_vma=False)
+    def run(params, head_params, xs, ts):
+        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
+        s = lax.axis_index(axis)
+        fperm = [(i, (i + 1) % S) for i in range(S)]
+        bperm = [(i, (i - 1) % S) for i in range(S)]
+        zeros_g = lambda tree: jax.tree.map(  # noqa: E731
+            lambda a: jnp.zeros(a.shape, jnp.float32), tree)
+
+        def masked_add(acc, upd, flag):
+            return jax.tree.map(
+                lambda a, u: a + jnp.where(flag, u.astype(a.dtype), 0), acc,
+                upd)
+
+        def tick(carry, t):
+            fwd_in, bwd_ct, resid, tg, hg, loss, aux = carry
+            # ---- forward: microbatch f = t - s ----
+            f = t - s
+            do_f = jnp.logical_and(f >= 0, f < M)
+            inp = jnp.where(s == 0,
+                            lax.dynamic_index_in_dim(
+                                xs, jnp.clip(f, 0, M - 1), keepdims=False),
+                            fwd_in)
+            out = stage_fn(params, inp)
+            # park the stage input in its ring slot (keep the old value on
+            # non-forward ticks so a live slot is never clobbered)
+            slot_f = jnp.clip(f, 0, M - 1) % R
+            old = lax.dynamic_index_in_dim(resid, slot_f, keepdims=False)
+            resid = lax.dynamic_update_index_in_dim(
+                resid, jnp.where(do_f, inp, old), slot_f, axis=0)
+            # ---- backward: microbatch b = t - (2S-2-s) ----
+            b = t - (2 * S - 2 - s)
+            do_b = jnp.logical_and(b >= 0, b < M)
+            bc = jnp.clip(b, 0, M - 1)
+            rin = lax.dynamic_index_in_dim(resid, bc % R, keepdims=False)
+            y2, stage_vjp = jax.vjp(lambda p, a: stage_fn(p, a), params, rin)
+            tgt = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, bc, keepdims=False),
+                ts)
+            if has_aux:
+                lval, head_vjp, aux_mb = jax.vjp(
+                    lambda hp, y: head_loss_fn(hp, y, tgt), head_params, y2,
+                    has_aux=True)
+            else:
+                lval, head_vjp = jax.vjp(
+                    lambda hp, y: head_loss_fn(hp, y, tgt), head_params, y2)
+                aux_mb = {}
+            dhp, dy = head_vjp(jnp.ones((), lval.dtype))
+            seed = jnp.where(s == S - 1, dy.astype(y2.dtype), bwd_ct)
+            dparams, dinp = stage_vjp(seed)
+            last = s == S - 1
+            tg = masked_add(tg, dparams, do_b)
+            hg = masked_add(hg, dhp, jnp.logical_and(do_b, last))
+            loss = loss + jnp.where(jnp.logical_and(do_b, last),
+                                    lval.astype(jnp.float32), 0.0)
+            aux = masked_add(aux, aux_mb, jnp.logical_and(do_b, last))
+            # ---- rotate carries; emit stage-0 input cotangents ----
+            fwd_next = lax.ppermute(out, axis, fperm)
+            bwd_next = lax.ppermute(dinp, axis, bperm)
+            dx_emit = jnp.where(jnp.logical_and(s == 0, do_b), dinp, 0)
+            return (fwd_next, bwd_next, resid, tg, hg, loss, aux), dx_emit
+
+        z = jnp.zeros_like(xs[0])
+        if has_aux:
+            y_s = jax.eval_shape(stage_fn, params, xs[0])
+            aux_shape = jax.eval_shape(
+                head_loss_fn, head_params, y_s,
+                jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape[1:],
+                                                            a.dtype), ts))[1]
+            aux0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
+                                aux_shape)
+        else:
+            aux0 = {}
+        carry0 = (z, z, jnp.zeros((R,) + xs.shape[1:], xs.dtype),
+                  zeros_g(params), zeros_g(head_params),
+                  jnp.zeros((), jnp.float32), aux0)
+        (_, _, _, tg, hg, loss, aux), dxs = lax.scan(tick, carry0,
+                                                     jnp.arange(T))
+
+        # stage 0 emits microbatch b's dx at tick 2S-2+b; other stages 0
+        dxs = lax.slice_in_dim(dxs, 2 * S - 2, 2 * S - 2 + M, axis=0)
+        dxs = jnp.where(s == 0, dxs, jnp.zeros_like(dxs))
+        dx = lax.psum(dxs, axis) * scale
+        loss = lax.psum(loss, axis)                  # only last stage added
+        hg = jax.tree.map(lambda a: lax.psum(a, axis), hg)
+        if dp_axes:
+            tg = jax.tree.map(lambda a: lax.psum(a, dp_axes), tg)
+            hg = jax.tree.map(lambda a: lax.psum(a, dp_axes), hg)
+            loss = lax.psum(loss, dp_axes)
+        aux = jax.tree.map(lambda a: lax.psum(a, axis), aux)
+        if dp_axes:
+            aux = jax.tree.map(lambda a: lax.psum(a, dp_axes), aux)
+        loss = loss * scale                          # Σ shard/mb sums → mean
+        hg = jax.tree.map(lambda a: a * scale, hg)
+        tg = jax.tree.map(lambda a: (a * scale)[None], tg)  # restack stage dim
+        return loss, tg, hg, dx, aux
+
+    loss, tg, hg, dx, aux = run(stacked_params, head_params, xs, ts)
+    dx = dx.reshape(B, *dx.shape[2:])
+    if has_aux:
+        return loss, tg, hg, dx, aux
+    return loss, tg, hg, dx

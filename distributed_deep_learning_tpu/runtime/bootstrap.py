@@ -31,6 +31,14 @@ def initialize_runtime(config: Config | None = None) -> DistributedEnv:
     runs (no-op).  Must run before the first device access on multi-host.
     """
     global _INITIALIZED
+    if os.environ.get("DDL_FORCE_CPU") == "1":
+        # spawned local ranks (runtime/launch.py) must not race for the
+        # accelerator; a site plugin may ignore JAX_PLATFORMS, so pin via
+        # jax.config (safe pre-backend-init, matching tests/conftest.py)
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
     dist = config.distributed if config is not None else DistributedEnv.from_environ()
     # Only latch once jax.distributed has actually been initialised — an
     # early single-process call must not turn a later multi-host call into

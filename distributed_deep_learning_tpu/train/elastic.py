@@ -52,9 +52,13 @@ def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
             if monitor is not None:
                 monitor.raise_if_failed()
                 monitor.check()
+            # fit polls the monitor before EVERY step, so a peer dying
+            # mid-epoch aborts this attempt promptly rather than hanging
+            # the next collective
             return fit(state, train_step, eval_step, train_loader,
                        val_loader, test_loader, epochs=epochs, logger=logger,
-                       checkpointer=checkpointer, start_epoch=start_epoch)
+                       checkpointer=checkpointer, start_epoch=start_epoch,
+                       monitor=monitor)
         except (WorkerFailure, RuntimeError) as e:
             restarts += 1
             if restarts > max_restarts:

@@ -36,10 +36,14 @@ class EpochResult:
         return self.examples / self.seconds if self.seconds > 0 else 0.0
 
 
-def _run_phase(step_fn, state, loader, *, train: bool):
+def _run_phase(step_fn, state, loader, *, train: bool, monitor=None):
     """Drive one phase; returns (state, totals) with one host sync at end."""
     device_metrics = []
     for x, y in loader:
+        if monitor is not None:
+            # cheap per-step liveness poll (an attribute read): a peer dying
+            # mid-epoch surfaces HERE instead of hanging the next collective
+            monitor.raise_if_failed()
         if train:
             state, m = step_fn(state, x, y)
         else:
@@ -66,19 +70,23 @@ def _result(phase: str, epoch: int | None, totals, t0: float, t1: float) -> Epoc
 
 def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
         test_loader, epochs: int, logger: PhaseLogger | None = None,
-        checkpointer=None, start_epoch: int = 1
+        checkpointer=None, start_epoch: int = 1, monitor=None
         ) -> tuple[TrainState, list[EpochResult]]:
     """Drive the epoch loop.  With a ``checkpointer``
     (:class:`..utils.checkpoint.Checkpointer`) the state is saved after
     every epoch (async) — pass ``start_epoch`` = last saved epoch + 1 to
-    resume a preempted run."""
+    resume a preempted run.  ``monitor``
+    (:class:`..utils.failures.FailureMonitor`) is polled before every step
+    so a dead peer raises :class:`..utils.failures.WorkerFailure` promptly
+    instead of hanging the next collective."""
     logger = logger or PhaseLogger(verbose=False)
     history: list[EpochResult] = []
 
     for epoch in range(start_epoch, epochs + 1):  # reference counts from 1
         train_loader.set_epoch(epoch)
         t0 = logger.phase_begin("train", epoch)
-        state, totals = _run_phase(train_step, state, train_loader, train=True)
+        state, totals = _run_phase(train_step, state, train_loader,
+                                   train=True, monitor=monitor)
         t1 = logger.clock()
         res = _result("train", epoch, totals, t0, t1)
         logger.phase_end("train", epoch, accuracy=res.accuracy, loss=res.loss)
@@ -89,7 +97,8 @@ def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
         history.append(res)
 
         t0 = logger.clock()
-        _, totals = _run_phase(eval_step, state, val_loader, train=False)
+        _, totals = _run_phase(eval_step, state, val_loader, train=False,
+                               monitor=monitor)
         t1 = logger.clock()
         res = _result("validation", epoch, totals, t0, t1)
         # reference prints only the validation end line (CNN/main.py:111)

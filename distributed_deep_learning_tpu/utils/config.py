@@ -142,6 +142,11 @@ class Config:
     profile_dir: str | None = None
     data_dir: str | None = None         # real-data root (ImageFolder layout)
     image_size: int = 224               # decode size for --data-dir images
+    attention: str = "auto"             # auto|dense|flash (transformer family)
+    pipeline_schedule: str = "gpipe"    # gpipe | 1f1b (SPMD pipeline mode)
+    elastic: bool = False               # checkpointed restart on failure
+    heartbeat_dir: str | None = None    # shared dir for liveness heartbeats
+    heartbeat_timeout: float = 30.0     # seconds before a peer counts as dead
     distributed: DistributedEnv = dataclasses.field(default_factory=DistributedEnv)
 
     def replace(self, **kw) -> "Config":
@@ -160,6 +165,7 @@ WORKLOAD_DEFAULTS: dict[str, dict[str, int]] = {
     "cnn": {"nlayers": 2, "size": 4},
     "lstm": {"nlayers": 1, "size": 128},
     "mlp": {"nlayers": 1, "size": 38},
+    "mnist": {"nlayers": 2, "size": 32},
     # north-star families (BASELINE.json): -s is depth (resnet) / width
     "resnet": {"nlayers": 4, "size": 18},
     "transformer": {"nlayers": 6, "size": 512},
@@ -233,6 +239,25 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
                         "-w sets the decode thread count")
     p.add_argument("--image-size", type=int, default=224,
                    help="square decode size for --data-dir images")
+    p.add_argument("--attention", choices=["auto", "dense", "flash"],
+                   default="auto",
+                   help="attention implementation for transformer-family "
+                        "models: auto = Pallas flash kernel on TPU, dense "
+                        "elsewhere")
+    p.add_argument("--pipeline-schedule", choices=["gpipe", "1f1b"],
+                   default="gpipe",
+                   help="SPMD pipeline schedule (-m pipeline, "
+                        "transformer/bert): gpipe = fill-drain with scan-"
+                        "transpose backward; 1f1b = interleaved one-forward-"
+                        "one-backward with O(stages) activation residency")
+    p.add_argument("--elastic", action="store_true",
+                   help="restart from the last checkpoint on worker failure "
+                        "or runtime error (requires --checkpoint-dir)")
+    p.add_argument("--heartbeat-dir", type=str, default=None,
+                   help="shared directory for liveness heartbeats; with "
+                        "--elastic, dead peers abort the step promptly "
+                        "instead of hanging the collective")
+    p.add_argument("--heartbeat-timeout", type=float, default=30.0)
     return p
 
 
@@ -278,5 +303,10 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         profile_dir=args.profile_dir,
         data_dir=args.data_dir,
         image_size=args.image_size,
+        attention=args.attention,
+        pipeline_schedule=args.pipeline_schedule,
+        elastic=args.elastic,
+        heartbeat_dir=args.heartbeat_dir,
+        heartbeat_timeout=args.heartbeat_timeout,
         distributed=dist,
     )

@@ -10,6 +10,8 @@ def get_spec(name: str):
     name = name.lower()
     if name == "mlp":
         from distributed_deep_learning_tpu.workloads.mlp import SPEC
+    elif name == "mnist":
+        from distributed_deep_learning_tpu.workloads.mnist import SPEC
     elif name == "cnn":
         from distributed_deep_learning_tpu.workloads.cnn import SPEC
     elif name == "lstm":
@@ -23,4 +25,5 @@ def get_spec(name: str):
     return SPEC
 
 
-WORKLOADS = ("mlp", "cnn", "lstm", "resnet", "transformer", "bert", "moe")
+WORKLOADS = ("mlp", "cnn", "lstm", "mnist", "resnet", "transformer",
+             "bert", "moe")

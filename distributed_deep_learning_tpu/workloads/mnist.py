@@ -1,0 +1,79 @@
+"""MNIST CNN workload — BASELINE configs[0] ("src/pytorch MNIST CNN,
+single-process CPU").
+
+Real idx-ubyte / .npy files when ``--data-dir`` points at them
+(:mod:`..data.mnist`), the synthetic shape-twin otherwise — the same
+real-vs-synthetic pattern as every other workload.  The model is the
+classic conv-pool ×2 → MLP (:class:`..models.resnet.MnistCNN`); staged
+modes partition its layer sequence like the reference stages every
+workload (reference ``CNN/model.py:206-255``).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from distributed_deep_learning_tpu.data.datasets import synthetic_mnist
+from distributed_deep_learning_tpu.models.resnet import MnistCNN
+from distributed_deep_learning_tpu.parallel.partition import balanced_partition
+from distributed_deep_learning_tpu.train.objectives import cross_entropy_loss
+from distributed_deep_learning_tpu.utils.config import Config, parse_args
+from distributed_deep_learning_tpu.workloads.base import (WorkloadSpec,
+                                                          config_dtype,
+                                                          example_from_dataset,
+                                                          run_workload)
+
+
+class _ConvPool(nn.Module):
+    features: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(nn.Conv(self.features, (3, 3),
+                            dtype=self.dtype)(x.astype(self.dtype)))
+        return nn.max_pool(x, (2, 2), (2, 2))
+
+
+class _DenseHead(nn.Module):
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(128, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes,
+                        dtype=self.dtype)(x).astype(jnp.float32)
+
+
+def _dataset(config: Config):
+    if config.data_dir:
+        from distributed_deep_learning_tpu.data.mnist import load_mnist
+
+        return load_mnist(config.data_dir)
+    return synthetic_mnist(seed=config.seed)
+
+
+def _layers(config: Config, dataset):
+    dtype = config_dtype(config)
+    return [_ConvPool(32, dtype), _ConvPool(64, dtype), _DenseHead(10, dtype)]
+
+
+SPEC = WorkloadSpec(
+    name="mnist",
+    build_dataset=_dataset,
+    build_model=lambda c, ds: MnistCNN(dtype=config_dtype(c)),
+    build_layers=_layers,
+    partitioner=balanced_partition,
+    build_loss=lambda c: cross_entropy_loss,
+    # the classic MNIST recipe: plain Adam, no schedule
+    build_optimizer=lambda c, steps: optax.adam(c.learning_rate),
+    example_input=example_from_dataset,
+)
+
+
+def main(argv=None):
+    return run_workload(SPEC, parse_args(argv, workload="mnist"))

@@ -128,6 +128,13 @@ class Seq2SeqAdapter(nn.Module):
 
 def _wmt_dataset(config: Config, src_len: int = 32, tgt_len: int = 32,
                  vocab: int = 1024):
+    if config.data_dir:
+        from distributed_deep_learning_tpu.data.tokens import (load_tokens,
+                                                               seq2seq_dataset)
+
+        tokens = load_tokens(config.data_dir)
+        if tokens is not None:
+            return seq2seq_dataset(tokens)
     ds = synthetic_wmt(src_len=src_len, tgt_len=tgt_len, vocab_size=vocab,
                        seed=config.seed)
     feats = np.concatenate([ds.features, ds.targets], axis=1)
@@ -139,11 +146,35 @@ def _transformer_model(config: Config, dataset):
     # --dropout seeds per-step PRNG streams through TrainState.rng;
     # the default 0.0 keeps steps deterministic (reference seed-42 contract)
     inner = TransformerSeq2Seq(
-        vocab_size=1024, num_layers=config.num_layers, d_model=d,
+        vocab_size=_vocab(dataset), num_layers=config.num_layers, d_model=d,
         num_heads=max(2, d // 64), mlp_dim=4 * d,
-        dropout_rate=config.dropout, dtype=config_dtype(config))
+        dropout_rate=config.dropout, dtype=config_dtype(config),
+        attention_fn=_attention_fn(config))
     src_len = dataset.features.shape[1] - dataset.targets.shape[1]
     return Seq2SeqAdapter(inner, src_len)
+
+
+def _attention_fn(config: Config):
+    """Resolve ``--attention``: the Pallas flash kernel is the TPU default
+    for the transformer family (in-kernel causal + padding masks, no (T×T)
+    score materialisation); dense elsewhere, and either can be forced."""
+    choice = config.attention
+    if choice == "auto":
+        import jax
+
+        choice = "flash" if jax.default_backend() == "tpu" else "dense"
+    if choice == "flash":
+        from distributed_deep_learning_tpu.ops.attention_pallas import (
+            make_attention_fn)
+
+        return make_attention_fn()
+    return None  # models fall back to dense dot_product_attention
+
+
+def _vocab(dataset) -> int:
+    """Vocabulary size: carried by file-based token datasets, 1024 for the
+    synthetic twins."""
+    return int(getattr(dataset, "vocab_size", 1024))
 
 
 def _lm_geometry(config: Config, dataset):
@@ -161,19 +192,12 @@ def _transformer_pipelined(config: Config, dataset, mesh):
     from distributed_deep_learning_tpu.models.pipelined_lm import PipelinedLM
 
     d, heads, mlp, src_len, tgt_len = _lm_geometry(config, dataset)
-    return PipelinedLM(vocab_size=1024, num_layers=config.num_layers,
+    return PipelinedLM(vocab_size=_vocab(dataset),
+                       num_layers=config.num_layers,
                        d_model=d, num_heads=heads, mlp_dim=mlp, mesh=mesh,
                        causal=True, head_take=(src_len - 1, tgt_len),
                        microbatch_size=config.microbatch,
                        dtype=config_dtype(config))
-
-
-def _reject_staged_dropout(config: Config) -> None:
-    # staged trunks are deterministic (same contract as -m pipeline);
-    # silently training with rate 0 would diverge from -m data
-    if config.dropout > 0:
-        raise ValueError("staged modes train a deterministic trunk; "
-                         "--dropout is not supported here (use -m data)")
 
 
 def _transformer_layers(config: Config, dataset):
@@ -184,14 +208,14 @@ def _transformer_layers(config: Config, dataset):
     from distributed_deep_learning_tpu.models.transformer import (
         TransformerLayer)
 
-    _reject_staged_dropout(config)
     d, heads, mlp, src_len, tgt_len = _lm_geometry(config, dataset)
     dtype = config_dtype(config)
-    return [LMEmbed(1024, d, dtype=dtype)] + [
+    vocab = _vocab(dataset)
+    return [LMEmbed(vocab, d, dtype=dtype)] + [
         TransformerLayer(heads, mlp, dropout_rate=0.0, causal=True,
                          dtype=dtype)
         for _ in range(config.num_layers)
-    ] + [LMHead(1024, take=(src_len - 1, tgt_len), dtype=dtype)]
+    ] + [LMHead(vocab, take=(src_len - 1, tgt_len), dtype=dtype)]
 
 
 TRANSFORMER_SPEC = WorkloadSpec(
@@ -212,6 +236,13 @@ TRANSFORMER_SPEC = WorkloadSpec(
 # --- bert (C4 MLM) ---------------------------------------------------------
 
 def _mlm_dataset(config: Config, vocab: int = 1024, mask_id: int = 103):
+    if config.data_dir:
+        from distributed_deep_learning_tpu.data.tokens import (load_tokens,
+                                                               mlm_dataset)
+
+        tokens = load_tokens(config.data_dir)
+        if tokens is not None:
+            return mlm_dataset(tokens, mask_id=mask_id, seed=config.seed)
     ds = synthetic_c4_mlm(vocab_size=vocab, mask_id=mask_id, seed=config.seed)
     # loss/metric sites are exactly the masked positions: keep the original
     # id there and 0 (= ignore) everywhere else, matching the pad-exclusion
@@ -222,10 +253,12 @@ def _mlm_dataset(config: Config, vocab: int = 1024, mask_id: int = 103):
 
 def _bert_model(config: Config, dataset):
     d = config.size
-    return BertEncoder(vocab_size=1024, num_layers=config.num_layers,
+    return BertEncoder(vocab_size=_vocab(dataset),
+                       num_layers=config.num_layers,
                        d_model=d, num_heads=max(2, d // 64), mlp_dim=4 * d,
                        dropout_rate=config.dropout,
-                       dtype=config_dtype(config))
+                       dtype=config_dtype(config),
+                       attention_fn=_attention_fn(config))
 
 
 def _bert_pipelined(config: Config, dataset, mesh):
@@ -234,7 +267,8 @@ def _bert_pipelined(config: Config, dataset, mesh):
     from distributed_deep_learning_tpu.models.pipelined_lm import PipelinedLM
 
     d = config.size
-    return PipelinedLM(vocab_size=1024, num_layers=config.num_layers,
+    return PipelinedLM(vocab_size=_vocab(dataset),
+                       num_layers=config.num_layers,
                        d_model=d, num_heads=max(2, d // 64), mlp_dim=4 * d,
                        mesh=mesh, causal=False,
                        microbatch_size=config.microbatch,
@@ -247,14 +281,14 @@ def _bert_layers(config: Config, dataset):
     from distributed_deep_learning_tpu.models.transformer import (
         TransformerLayer)
 
-    _reject_staged_dropout(config)
     d = config.size
     dtype = config_dtype(config)
-    return [LMEmbed(1024, d, dtype=dtype)] + [
+    vocab = _vocab(dataset)
+    return [LMEmbed(vocab, d, dtype=dtype)] + [
         TransformerLayer(max(2, d // 64), 4 * d, dropout_rate=0.0,
                          dtype=dtype)
         for _ in range(config.num_layers)
-    ] + [LMHead(1024, dtype=dtype)]
+    ] + [LMHead(vocab, dtype=dtype)]
 
 
 BERT_SPEC = WorkloadSpec(
@@ -277,10 +311,12 @@ def _moe_model(config: Config, dataset):
     from distributed_deep_learning_tpu.models.moe import MoELM
 
     d = config.size
-    return MoELM(vocab_size=1024, num_layers=config.num_layers, d_model=d,
+    return MoELM(vocab_size=_vocab(dataset),
+                 num_layers=config.num_layers, d_model=d,
                  num_heads=max(2, d // 64), mlp_dim=4 * d,
                  num_experts=8, dropout_rate=config.dropout,
-                 dtype=config_dtype(config))
+                 dtype=config_dtype(config),
+                 attention_fn=_attention_fn(config))
 
 
 def _moe_rules(config: Config):

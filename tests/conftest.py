@@ -26,6 +26,11 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process / long-running integration tests")
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     from distributed_deep_learning_tpu.runtime.mesh import build_mesh

@@ -91,3 +91,54 @@ def test_monitor_failure_triggers_recovery_path(tmp_path, mesh8):
             fit_with_recovery(make_state, train_step, eval_step, loaders,
                               epochs=1, checkpointer=ckpt, monitor=monitor,
                               max_restarts=1)
+
+
+class _FailAfterSteps:
+    """Monitor double that reports a dead peer after N raise_if_failed
+    polls — i.e. mid-epoch, between two train steps."""
+
+    def __init__(self, after: int):
+        self.calls = 0
+        self.after = after
+
+    def check(self):
+        pass
+
+    def raise_if_failed(self):
+        self.calls += 1
+        if self.calls > self.after:
+            raise WorkerFailure([3])
+
+
+def test_monitor_polled_every_step(mesh8):
+    """fit() polls the monitor per step: a peer dying mid-epoch aborts the
+    phase promptly instead of only being checked before the run."""
+    make_state, (train_step, eval_step), loaders = _setup(mesh8)
+    monitor = _FailAfterSteps(after=2)
+    with pytest.raises(WorkerFailure):
+        fit(make_state(), train_step, eval_step, *loaders, epochs=5,
+            monitor=monitor)
+    # it raised after the 3rd poll, i.e. mid-first-epoch, not at the end
+    assert monitor.calls == 3
+
+
+def test_mid_epoch_failure_triggers_recovery(tmp_path, mesh8):
+    """fit_with_recovery + per-step polling: a mid-epoch WorkerFailure on
+    attempt 1 restarts and completes from the last checkpoint."""
+    make_state, (train_step, eval_step), loaders = _setup(mesh8)
+
+    class _FailOnceMidEpoch(_FailAfterSteps):
+        def raise_if_failed(self):
+            self.calls += 1
+            if self.calls == self.after:  # exactly once, mid-epoch
+                raise WorkerFailure([1])
+
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    try:
+        _, history = fit_with_recovery(
+            make_state, train_step, eval_step, loaders, epochs=2,
+            checkpointer=ckpt, monitor=_FailOnceMidEpoch(after=4),
+            max_restarts=2)
+    finally:
+        ckpt.close()
+    assert [h.phase for h in history].count("train") == 2

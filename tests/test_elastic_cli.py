@@ -1,0 +1,71 @@
+"""--elastic / --heartbeat-dir behind the CLI: checkpointed restart wired
+into run_workload (the reference's failure model is 'any rank failure hangs
+the job', reference CNN/main.py:183-184; this is the recover path)."""
+
+import numpy as np
+import pytest
+
+import distributed_deep_learning_tpu.train.elastic as elastic_mod
+from distributed_deep_learning_tpu.utils.config import (Config,
+                                                        DistributedEnv, Mode,
+                                                        parse_args)
+from distributed_deep_learning_tpu.utils.failures import WorkerFailure
+from distributed_deep_learning_tpu.workloads.base import run_workload
+from distributed_deep_learning_tpu.workloads.mlp import SPEC as MLP_SPEC
+
+
+def test_cli_parses_elastic_flags():
+    c = parse_args(["--elastic", "--checkpoint-dir", "/tmp/ck",
+                    "--heartbeat-dir", "/tmp/hb",
+                    "--heartbeat-timeout", "7.5"], workload="mlp")
+    assert c.elastic and c.checkpoint_dir == "/tmp/ck"
+    assert c.heartbeat_dir == "/tmp/hb" and c.heartbeat_timeout == 7.5
+
+
+def test_elastic_requires_checkpoint_dir(monkeypatch):
+    monkeypatch.setenv("DDL_DATA_LIMIT", "128")
+    config = Config(mode=Mode.DATA, epochs=1, batch_size=32, elastic=True)
+    with pytest.raises(ValueError, match="checkpoint-dir"):
+        run_workload(MLP_SPEC, config)
+
+
+def test_elastic_recovers_through_cli(tmp_path, monkeypatch):
+    """A runtime error on the first attempt restarts from the checkpoint
+    and the run completes — all through run_workload."""
+    monkeypatch.setenv("DDL_DATA_LIMIT", "256")
+    real_fit = elastic_mod.fit
+    calls = {"n": 0}
+
+    def flaky_fit(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("injected device failure")
+        return real_fit(*args, **kwargs)
+
+    monkeypatch.setattr(elastic_mod, "fit", flaky_fit)
+    config = Config(mode=Mode.DATA, epochs=2, batch_size=32, elastic=True,
+                    checkpoint_dir=str(tmp_path / "ck"))
+    _, history = run_workload(MLP_SPEC, config)
+    assert calls["n"] == 2  # failed once, recovered, finished
+    phases = [h.phase for h in history]
+    assert phases.count("train") == 2 and "test" in phases
+    assert np.isfinite(history[0].loss)
+
+
+def test_elastic_detects_dead_peer_via_heartbeats(tmp_path, monkeypatch):
+    """World size 2 with a never-beating rank 1: the CLI-wired monitor
+    raises WorkerFailure instead of hanging (exhausts restarts)."""
+    monkeypatch.setenv("DDL_DATA_LIMIT", "128")
+    # a 2-process env would trigger jax.distributed.initialize, which the
+    # already-initialised test process cannot do — the monitor wiring under
+    # test only needs the declared world size
+    import distributed_deep_learning_tpu.workloads.base as base_mod
+
+    monkeypatch.setattr(base_mod, "initialize_runtime", lambda c: None)
+    config = Config(
+        mode=Mode.DATA, epochs=1, batch_size=32, elastic=True,
+        checkpoint_dir=str(tmp_path / "ck"),
+        heartbeat_dir=str(tmp_path / "hb"), heartbeat_timeout=0.2,
+        distributed=DistributedEnv(process_id=0, num_processes=2))
+    with pytest.raises(WorkerFailure):
+        run_workload(MLP_SPEC, config)

@@ -120,3 +120,47 @@ def test_prefetch_loader_yields_same_batches(mesh8):
     for (x1, y1), (x2, y2) in zip(direct, prefetched):
         np.testing.assert_array_equal(x1, x2)
         np.testing.assert_array_equal(y1, y2)
+
+
+def test_csv_blank_lines_do_not_shift_rows(tmp_path, lib_available):
+    """Blank/whitespace lines are skipped (genfromtxt parity), not parsed
+    as zero rows that shift everything after them."""
+    path = tmp_path / "blank.csv"
+    path.write_text("h1,h2\n1,2\n\n   \n3,4\n\n5,6\n")
+    got = native.read_csv(str(path), skip_header=True)
+    np.testing.assert_array_equal(got, [[1, 2], [3, 4], [5, 6]])
+
+
+def test_csv_short_row_does_not_consume_next_row(tmp_path, lib_available):
+    """A row with missing trailing fields parses to zeros for the missing
+    columns; strtof must not skip the newline into the next row."""
+    path = tmp_path / "short.csv"
+    path.write_text("h1,h2,h3\n1,2,3\n4,\n7,8,9\n")
+    got = native.read_csv(str(path), skip_header=True)
+    np.testing.assert_array_equal(got, [[1, 2, 3], [4, 0, 0], [7, 8, 9]])
+
+
+def test_csv_nan_parity_with_fallback(tmp_path, lib_available):
+    """Literal nan fields become 0.0 on BOTH paths (the fallback applies
+    np.nan_to_num; the native parser must match)."""
+    path = tmp_path / "nan.csv"
+    path.write_text("h1,h2\n1,nan\nNaN,4\n")
+    got = native.read_csv(str(path), skip_header=True)
+    np.testing.assert_array_equal(got, [[1, 0], [0, 4]])
+    assert np.isfinite(got).all()
+
+
+def test_csv_empty_mid_field(tmp_path, lib_available):
+    path = tmp_path / "mid.csv"
+    path.write_text("h1,h2,h3\n1,,3\n,5,\n")
+    got = native.read_csv(str(path), skip_header=True)
+    np.testing.assert_array_equal(got, [[1, 0, 3], [0, 5, 0]])
+
+
+def test_csv_leading_blank_line_column_count(tmp_path, lib_available):
+    """Columns derive from the first NON-blank data line (a leading blank
+    would otherwise report cols=1 and mangle the file)."""
+    path = tmp_path / "lead.csv"
+    path.write_text("h1,h2\n\n1,2\n3,4\n")
+    got = native.read_csv(str(path), skip_header=True)
+    np.testing.assert_array_equal(got, [[1, 2], [3, 4]])

@@ -135,3 +135,19 @@ def test_pipelined_lm_matches_sequential(mesh_4x2):
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
                                rtol=2e-4, atol=2e-5)
     assert ms == {} and float(aux) == 0.0
+
+
+def test_mpmd_staged_rejects_unsupported_flags(monkeypatch):
+    """MPMD staging rejects flags it would otherwise silently drop
+    (checkpointing, grad accumulation, remat, zero) — advisor finding."""
+    monkeypatch.setenv("DDL_DATA_LIMIT", "32")
+    base = dict(mode=Mode.MODEL, size=18, epochs=1, batch_size=8,
+                num_stages=2)
+    with pytest.raises(ValueError, match="--remat"):
+        run_workload(RESNET_SPEC, Config(**base, remat=True))
+    with pytest.raises(ValueError, match="--grad-accum"):
+        run_workload(RESNET_SPEC, Config(**base, grad_accum=4))
+    with pytest.raises(ValueError, match="--checkpoint-dir"):
+        run_workload(RESNET_SPEC, Config(**base, checkpoint_dir="/tmp/x"))
+    with pytest.raises(ValueError, match="--zero"):
+        run_workload(RESNET_SPEC, Config(**base, zero="1"))

@@ -1,0 +1,169 @@
+"""1F1B pipelined train pass: parity with the GPipe/autodiff path and the
+O(S) activation-residency property (VERDICT item: cut all-microbatch
+residency; the reference's scheduler is forward-only, MLP/model.py:81-130)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_deep_learning_tpu.parallel.spmd_pipeline import (
+    one_f_one_b_schedule, spmd_pipeline, spmd_pipeline_1f1b,
+    stack_stage_params)
+from distributed_deep_learning_tpu.runtime.mesh import build_mesh
+
+S, D = 4, 16
+
+
+class Block(nn.Module):
+    @nn.compact
+    def __call__(self, h):
+        return h + nn.Dense(D, kernel_init=nn.initializers.lecun_normal())(
+            nn.relu(h))
+
+
+@pytest.fixture(scope="module")
+def setup():
+    mesh = build_mesh({"stage": S, "data": 2})
+    blk = Block()
+    key = jax.random.key(0)
+    h0 = jnp.zeros((1, D))
+    trunk = stack_stage_params(
+        [blk.init(jax.random.fold_in(key, i), h0)["params"]
+         for i in range(S)])
+    head = nn.Dense(8)
+    x = jax.random.normal(jax.random.key(1), (16, D))
+    y = jax.nn.one_hot(jax.random.randint(jax.random.key(2), (16,), 0, 8), 8)
+    head_params = head.init(jax.random.key(3), x)["params"]
+    stage_fn = lambda p, a: blk.apply({"params": p}, a)  # noqa: E731
+
+    def head_loss(hp, h, tgt):
+        logits = head.apply({"params": hp}, h)
+        return jnp.mean(optax.softmax_cross_entropy(logits, tgt))
+
+    return mesh, stage_fn, head_loss, trunk, head_params, x, y
+
+
+def _reference_loss(setup_vals):
+    """Same computation via spmd_pipeline + outer autodiff (GPipe path)."""
+    mesh, stage_fn, head_loss, trunk, head_params, x, y = setup_vals
+
+    def loss_fn(trunk, hp, x):
+        h = spmd_pipeline(stage_fn, trunk, x, mesh=mesh, microbatch_size=4)
+        return head_loss(hp, h, y)
+
+    with mesh:
+        loss, grads = jax.jit(jax.value_and_grad(
+            loss_fn, argnums=(0, 1, 2)))(trunk, head_params, x)
+    return loss, grads
+
+
+def test_1f1b_matches_gpipe_loss_and_grads(setup):
+    mesh, stage_fn, head_loss, trunk, head_params, x, y = setup
+    with mesh:
+        loss, tg, hg, dx = jax.jit(
+            lambda t, hp, x, y: spmd_pipeline_1f1b(
+                stage_fn, head_loss, t, hp, x, y, mesh=mesh,
+                microbatch_size=4))(trunk, head_params, x, y)
+    ref_loss, (ref_tg, ref_hg, ref_dx) = _reference_loss(setup)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6), tg, ref_tg)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6), hg, ref_hg)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(ref_dx),
+                               rtol=2e-4, atol=1e-6)
+
+
+def test_1f1b_sgd_step_trains(setup):
+    """A few hand-rolled SGD steps with 1F1B grads reduce the loss."""
+    mesh, stage_fn, head_loss, trunk, head_params, x, y = setup
+
+    @jax.jit
+    def step(trunk, hp):
+        loss, tg, hg, _ = spmd_pipeline_1f1b(
+            stage_fn, head_loss, trunk, hp, x, y, mesh=mesh,
+            microbatch_size=4)
+        upd = lambda p, g: jax.tree.map(  # noqa: E731
+            lambda a, b: a - 0.5 * b.astype(a.dtype), p, g)
+        return loss, upd(trunk, tg), upd(hp, hg)
+
+    with mesh:
+        losses = []
+        for _ in range(5):
+            loss, trunk, head_params = step(trunk, head_params)
+            losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_schedule_residency_bound():
+    """Peak in-flight microbatches per stage is O(S), independent of M —
+    the property GPipe-with-scan-transpose lacks (O(M) residency)."""
+    M, St = 64, 8
+    ops = one_f_one_b_schedule(M, St)
+    # track live residuals per stage: +1 at its F tick, -1 at its B tick
+    peak = {s: 0 for s in range(St)}
+    live = {s: 0 for s in range(St)}
+    for t, s, op, m in sorted(ops):
+        live[s] += 1 if op == "F" else -1
+        peak[s] = max(peak[s], live[s])
+    assert all(live[s] == 0 for s in live)           # every F has its B
+    assert max(peak.values()) <= 2 * St - 1          # O(S), not O(M)=64
+    assert max(peak.values()) < M / 2
+
+
+def test_schedule_is_complete_and_causal():
+    M, St = 6, 4
+    ops = one_f_one_b_schedule(M, St)
+    fwd = {(s, m): t for t, s, op, m in ops if op == "F"}
+    bwd = {(s, m): t for t, s, op, m in ops if op == "B"}
+    assert len(fwd) == len(bwd) == M * St
+    for m in range(M):
+        for s in range(St):
+            if s > 0:  # forward flows left→right, one tick per hop
+                assert fwd[(s, m)] == fwd[(s - 1, m)] + 1
+            if s < St - 1:  # backward flows right→left
+                assert bwd[(s, m)] == bwd[(s + 1, m)] + 1
+            # a stage backwards a microbatch only after forwarding it
+            assert bwd[(s, m)] >= fwd[(s, m)]
+
+
+def test_schedule_total_ticks():
+    """T = M + 2S - 2 combined ticks; with M >> S the bubble fraction
+    (2S-2)/(M+2S-2) vanishes."""
+    M, St = 32, 4
+    ops = one_f_one_b_schedule(M, St)
+    T = max(t for t, *_ in ops) + 1
+    assert T == M + 2 * St - 2
+
+
+def test_cli_1f1b_schedule_trains(monkeypatch):
+    """bert -m pipeline --pipeline-schedule 1f1b end-to-end, and its loss
+    trajectory matches the GPipe schedule (same weights, same data)."""
+    from distributed_deep_learning_tpu.utils.config import Config, Mode
+    from distributed_deep_learning_tpu.workloads.base import run_workload
+    from distributed_deep_learning_tpu.workloads.northstar import BERT_SPEC
+
+    monkeypatch.setenv("DDL_DATA_LIMIT", "96")
+    base = dict(mode=Mode.PIPELINE, num_layers=2, size=32, epochs=2,
+                batch_size=16, num_stages=2, microbatch=8,
+                learning_rate=1e-2)
+    _, h_1f1b = run_workload(
+        BERT_SPEC, Config(**base, pipeline_schedule="1f1b"))
+    _, h_gpipe = run_workload(BERT_SPEC, Config(**base))
+    l1 = [h.loss for h in h_1f1b if h.phase == "train"]
+    lg = [h.loss for h in h_gpipe if h.phase == "train"]
+    assert l1[-1] < l1[0]  # it learns
+    np.testing.assert_allclose(l1, lg, rtol=1e-3)  # same trajectory
+    a1 = [h.accuracy for h in h_1f1b if h.phase == "train"]
+    ag = [h.accuracy for h in h_gpipe if h.phase == "train"]
+    np.testing.assert_allclose(a1, ag, rtol=1e-3, atol=0.5)
+
+
+def test_cli_parses_pipeline_schedule():
+    from distributed_deep_learning_tpu.utils.config import parse_args
+
+    c = parse_args(["--pipeline-schedule", "1f1b"], workload="bert")
+    assert c.pipeline_schedule == "1f1b"
