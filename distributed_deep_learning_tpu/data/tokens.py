@@ -37,7 +37,7 @@ def load_tokens(root: str) -> np.ndarray | None:
     path = os.path.join(os.fspath(root), TOKENS_FILE)
     if not os.path.exists(path):
         return None
-    tokens = np.load(path, mmap_mode="r")
+    tokens = np.load(path)
     if tokens.ndim != 2 or not np.issubdtype(tokens.dtype, np.integer):
         raise ValueError(f"{path}: expected a 2-D integer array, got "
                          f"{tokens.shape} {tokens.dtype}")

@@ -88,12 +88,14 @@ class PipelinedLM:
                  causal: bool = False,
                  head_take: Optional[tuple[int, int]] = None,
                  microbatch_size: Optional[int] = None,
-                 max_len: int = 4096, dtype: jnp.dtype = jnp.float32):
+                 max_len: int = 4096, dtype: jnp.dtype = jnp.float32,
+                 attention_fn=None):
         self.embed = LMEmbed(vocab_size, d_model, max_len, dtype)
         self.trunk = PipelinedTrunk(num_layers, mesh, num_heads=num_heads,
                                     mlp_dim=mlp_dim, causal=causal,
                                     dtype=dtype,
-                                    microbatch_size=microbatch_size)
+                                    microbatch_size=microbatch_size,
+                                    attention_fn=attention_fn)
         self.head = LMHead(vocab_size, head_take, dtype)
 
     def init(self, rng: jax.Array, tokens: jnp.ndarray) -> dict[str, Any]:

@@ -122,8 +122,9 @@ def _dense_attention_bhtd(q, k, v, kvalid, sm_scale, causal):
     """(BH, T, D) dense reference used for the rematerialised backward."""
     s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * sm_scale
     if causal:
-        T = q.shape[1]
-        mask = jnp.tril(jnp.ones((T, T), bool))
+        # rectangular (Tq, Tk) mask on absolute positions — must match the
+        # kernel's q_pos >= k_pos rule when Tq != Tk (cross-attention)
+        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
         s = jnp.where(mask[None], s, NEG_INF)
     if kvalid is not None:
         s = jnp.where(kvalid[:, None, :] > 0, s, NEG_INF)

@@ -33,7 +33,9 @@ class TrunkStage(nn.Module):
 
     Dropout is 0 inside the pipeline (stochasticity would need per-stage
     PRNG threading through shard_map; deterministic trunks match the
-    framework's seed contract).
+    framework's seed contract).  ``attention_fn`` plugs the Pallas flash
+    kernel into every block (padding masks are not threaded through the
+    pipeline — pad to microbatch boundaries instead).
     """
 
     layers_per_stage: int
@@ -41,13 +43,16 @@ class TrunkStage(nn.Module):
     mlp_dim: int = 2048
     causal: bool = False
     dtype: jnp.dtype = jnp.float32
+    attention_fn: object = None
 
     @nn.compact
     def __call__(self, x):
         for i in range(self.layers_per_stage):
             x = TransformerLayer(self.num_heads, self.mlp_dim,
                                  dropout_rate=0.0, causal=self.causal,
-                                 dtype=self.dtype, name=f"block_{i}")(x)
+                                 dtype=self.dtype,
+                                 attention_fn=self.attention_fn,
+                                 name=f"block_{i}")(x)
         return x
 
 
@@ -57,7 +62,8 @@ class PipelinedTrunk:
     def __init__(self, num_layers: int, mesh: Mesh, *, num_heads: int = 8,
                  mlp_dim: int = 2048, causal: bool = False,
                  dtype: jnp.dtype = jnp.float32,
-                 microbatch_size: Optional[int] = None):
+                 microbatch_size: Optional[int] = None,
+                 attention_fn=None):
         self.mesh = mesh
         self.n_stages = mesh.shape["stage"]
         if num_layers % self.n_stages:
@@ -65,7 +71,7 @@ class PipelinedTrunk:
                              f"{self.n_stages} stages")
         self.microbatch_size = microbatch_size
         self.stage = TrunkStage(num_layers // self.n_stages, num_heads,
-                                mlp_dim, causal, dtype)
+                                mlp_dim, causal, dtype, attention_fn)
 
     def init(self, rng: jax.Array, example: jnp.ndarray) -> Any:
         """Stacked per-stage params (leading dim = stage; shard it)."""

@@ -69,16 +69,38 @@ def launch_local(n_processes: int, argv: Sequence[str], *,
         procs.append(subprocess.Popen(
             [sys.executable, "-m", module, *argv], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    # drain every rank's pipe CONCURRENTLY: a crashing rank that fills its
+    # 64KB pipe buffer would otherwise block, stall the collective its
+    # peers wait on, and turn one rank's failure into a timeout that
+    # discards the very log that explains it
+    import threading
+    import time as _time
+
+    outputs = [""] * n_processes
+
+    def drain(i: int, p: subprocess.Popen):
+        outputs[i] = p.stdout.read()
+
+    drainers = [threading.Thread(target=drain, args=(i, p), daemon=True)
+                for i, p in enumerate(procs)]
+    for t in drainers:
+        t.start()
+    deadline = None if timeout is None else _time.monotonic() + timeout
     results = []
     for rank, p in enumerate(procs):
+        left = None if deadline is None else max(0.0,
+                                                 deadline - _time.monotonic())
         try:
-            out, _ = p.communicate(timeout=timeout)
+            p.wait(timeout=left)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
             raise
+    for t in drainers:
+        t.join(timeout=10)
+    for rank, p in enumerate(procs):
         results.append(subprocess.CompletedProcess(p.args, p.returncode,
-                                                   stdout=out))
+                                                   stdout=outputs[rank]))
     bad = [r for r in results if r.returncode != 0]
     if bad:
         tails = "\n---\n".join(r.stdout[-2000:] for r in bad)

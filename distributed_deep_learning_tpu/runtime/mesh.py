@@ -86,6 +86,36 @@ class MeshSpec:
         return MeshSpec(**dict(zip(AXES, sizes)))
 
 
+def _device_array(sizes: tuple[int, ...], devices) -> np.ndarray:
+    """Arrange devices into the mesh shape, torus-aware on real TPUs.
+
+    On multi-chip TPU, ``mesh_utils.create_device_mesh`` permutes devices so
+    mesh axes land on physical ICI torus axes (nearest-neighbour collectives
+    instead of topology-oblivious strides); across pod slices,
+    ``create_hybrid_device_mesh`` puts the outermost (bandwidth-tolerant,
+    see AXES ordering) axis on DCN.  Everything else — CPU test meshes,
+    single chip — keeps the deterministic topology-sorted reshape.
+    """
+    devices = list(devices)
+    if devices[0].platform == "tpu" and len(devices) > 1:
+        from jax.experimental import mesh_utils
+
+        slices = {getattr(d, "slice_index", 0) for d in devices}
+        try:
+            if len(slices) > 1:
+                n_slices = len(slices)
+                if sizes[0] % n_slices == 0:
+                    per_slice = (sizes[0] // n_slices,) + sizes[1:]
+                    dcn = (n_slices,) + (1,) * (len(sizes) - 1)
+                    return mesh_utils.create_hybrid_device_mesh(
+                        per_slice, dcn, devices=devices)
+            else:
+                return mesh_utils.create_device_mesh(sizes, devices=devices)
+        except Exception:
+            pass  # unusual topology: fall through to the plain reshape
+    return np.asarray(devices).reshape(sizes)
+
+
 def build_mesh(spec: MeshSpec | dict[str, int] | None = None,
                devices: Sequence[jax.Device] | None = None) -> Mesh:
     """Build a named Mesh over `devices` (default: all of them)."""
@@ -96,8 +126,7 @@ def build_mesh(spec: MeshSpec | dict[str, int] | None = None,
     if isinstance(spec, dict):
         spec = MeshSpec.from_dict(spec)
     spec = spec.resolve(len(devices))
-    arr = np.asarray(devices).reshape(spec.sizes())
-    return Mesh(arr, AXES)
+    return Mesh(_device_array(spec.sizes(), devices), AXES)
 
 
 def mesh_for_mode(mode: "str | None", n_stages: int | None = None,

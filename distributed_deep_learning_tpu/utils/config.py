@@ -144,6 +144,8 @@ class Config:
     image_size: int = 224               # decode size for --data-dir images
     attention: str = "auto"             # auto|dense|flash (transformer family)
     pipeline_schedule: str = "gpipe"    # gpipe | 1f1b (SPMD pipeline mode)
+    lr_schedule: str = "none"           # none|cosine|rsqrt|step (north stars)
+    warmup_steps: int | None = None     # cosine/rsqrt warmup; None = 5% auto
     elastic: bool = False               # checkpointed restart on failure
     heartbeat_dir: str | None = None    # shared dir for liveness heartbeats
     heartbeat_timeout: float = 30.0     # seconds before a peer counts as dead
@@ -244,6 +246,15 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
                    help="attention implementation for transformer-family "
                         "models: auto = Pallas flash kernel on TPU, dense "
                         "elsewhere")
+    p.add_argument("--schedule", dest="lr_schedule",
+                   choices=["none", "cosine", "rsqrt", "step"],
+                   default="none",
+                   help="learning-rate schedule: cosine (ResNet/BERT "
+                        "recipe), rsqrt (transformer-base Noam), step "
+                        "(the reference's StepLR)")
+    p.add_argument("--warmup", dest="warmup_steps", type=int, default=None,
+                   help="warmup steps for --schedule cosine/rsqrt "
+                        "(default: 5%% of total steps; 0 disables warmup)")
     p.add_argument("--pipeline-schedule", choices=["gpipe", "1f1b"],
                    default="gpipe",
                    help="SPMD pipeline schedule (-m pipeline, "
@@ -305,6 +316,8 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         image_size=args.image_size,
         attention=args.attention,
         pipeline_schedule=args.pipeline_schedule,
+        lr_schedule=args.lr_schedule,
+        warmup_steps=args.warmup_steps,
         elastic=args.elastic,
         heartbeat_dir=args.heartbeat_dir,
         heartbeat_timeout=args.heartbeat_timeout,

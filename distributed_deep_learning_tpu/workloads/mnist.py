@@ -23,6 +23,7 @@ from distributed_deep_learning_tpu.utils.config import Config, parse_args
 from distributed_deep_learning_tpu.workloads.base import (WorkloadSpec,
                                                           config_dtype,
                                                           example_from_dataset,
+                                                          resolve_lr,
                                                           run_workload)
 
 
@@ -69,8 +70,9 @@ SPEC = WorkloadSpec(
     build_layers=_layers,
     partitioner=balanced_partition,
     build_loss=lambda c: cross_entropy_loss,
-    # the classic MNIST recipe: plain Adam, no schedule
-    build_optimizer=lambda c, steps: optax.adam(c.learning_rate),
+    # the classic MNIST recipe: plain Adam (schedulable via --schedule)
+    build_optimizer=lambda c, steps: optax.adam(
+        resolve_lr(c, steps, c.learning_rate)),
     example_input=example_from_dataset,
 )
 

@@ -47,6 +47,7 @@ from distributed_deep_learning_tpu.utils.config import Config, parse_args
 from distributed_deep_learning_tpu.workloads.base import (WorkloadSpec,
                                                           config_dtype,
                                                           example_from_dataset,
+                                                          resolve_lr,
                                                           run_workload)
 
 _RESNET_LAYERS = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3), 50: (3, 4, 6, 3)}
@@ -104,7 +105,9 @@ RESNET_SPEC = WorkloadSpec(
     partitioner=balanced_partition,
     build_loss=lambda c: cross_entropy_loss,
     build_optimizer=lambda c, steps: optax.sgd(
-        c.learning_rate if c.learning_rate != 1e-3 else 0.1, momentum=0.9),
+        resolve_lr(c, steps,
+                   c.learning_rate if c.learning_rate != 1e-3 else 0.1),
+        momentum=0.9),
     example_input=example_from_dataset,
 )
 
@@ -197,7 +200,8 @@ def _transformer_pipelined(config: Config, dataset, mesh):
                        d_model=d, num_heads=heads, mlp_dim=mlp, mesh=mesh,
                        causal=True, head_take=(src_len - 1, tgt_len),
                        microbatch_size=config.microbatch,
-                       dtype=config_dtype(config))
+                       dtype=config_dtype(config),
+                       attention_fn=_attention_fn(config))
 
 
 def _transformer_layers(config: Config, dataset):
@@ -225,7 +229,8 @@ TRANSFORMER_SPEC = WorkloadSpec(
     build_layers=_transformer_layers,
     partitioner=balanced_partition,
     build_loss=lambda c: token_cross_entropy,
-    build_optimizer=lambda c, steps: optax.adamw(c.learning_rate),
+    build_optimizer=lambda c, steps: optax.adamw(
+        resolve_lr(c, steps, c.learning_rate)),
     example_input=lambda c, ds: jnp.zeros((1, ds.features.shape[1]),
                                           jnp.int32),
     tp_rules=lambda c: transformer_tp_rules(),
@@ -272,7 +277,8 @@ def _bert_pipelined(config: Config, dataset, mesh):
                        d_model=d, num_heads=max(2, d // 64), mlp_dim=4 * d,
                        mesh=mesh, causal=False,
                        microbatch_size=config.microbatch,
-                       dtype=config_dtype(config))
+                       dtype=config_dtype(config),
+                       attention_fn=_attention_fn(config))
 
 
 def _bert_layers(config: Config, dataset):
@@ -298,7 +304,8 @@ BERT_SPEC = WorkloadSpec(
     build_layers=_bert_layers,
     partitioner=balanced_partition,
     build_loss=lambda c: token_cross_entropy,
-    build_optimizer=lambda c, steps: optax.adamw(c.learning_rate),
+    build_optimizer=lambda c, steps: optax.adamw(
+        resolve_lr(c, steps, c.learning_rate)),
     example_input=lambda c, ds: jnp.zeros((1, ds.features.shape[1]),
                                           jnp.int32),
     tp_rules=lambda c: transformer_tp_rules(),
@@ -341,7 +348,8 @@ MOE_SPEC = WorkloadSpec(
     build_layers=_moe_no_staging,
     partitioner=lambda n, s: np.zeros(n, np.int64),
     build_loss=lambda c: token_cross_entropy,
-    build_optimizer=lambda c, steps: optax.adamw(c.learning_rate),
+    build_optimizer=lambda c, steps: optax.adamw(
+        resolve_lr(c, steps, c.learning_rate)),
     example_input=lambda c, ds: jnp.zeros((1, ds.features.shape[1]),
                                           jnp.int32),
     tp_rules=_moe_rules,

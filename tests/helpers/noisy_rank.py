@@ -1,0 +1,7 @@
+"""Test helper: a rank that floods stdout and fails on rank 1 (exercises
+launch_local's concurrent pipe draining)."""
+import os
+import sys
+
+sys.stdout.write("x" * 200000)
+sys.exit(3 if os.environ.get("DDL_PROCESS_ID") == "1" else 0)

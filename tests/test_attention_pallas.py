@@ -187,3 +187,24 @@ def test_transformer_layer_with_flash_attention():
     np.testing.assert_allclose(
         np.asarray(flash_layer.apply(params, x)),
         np.asarray(dense_layer.apply(params, x)), rtol=1e-4, atol=1e-5)
+
+
+def test_causal_cross_length_backward():
+    """Backward with causal=True and Tq != Tk must use the rectangular
+    absolute-position mask (review regression: tril was square)."""
+    B, H, D = 2, 2, 16
+    ks = jax.random.split(jax.random.key(12), 3)
+    q = jax.random.normal(ks[0], (B, 8, H, D))
+    k = jax.random.normal(ks[1], (B, 32, H, D))
+    v = jax.random.normal(ks[2], (B, 32, H, D))
+    g = jax.grad(lambda q: jnp.sum(
+        flash_attention(q, k, v, causal=True, block_q=8, block_k=8) ** 2))(q)
+    assert np.isfinite(np.asarray(g)).all()
+    # parity with the dense structured path
+    from distributed_deep_learning_tpu.models.transformer import (
+        dot_product_attention)
+
+    gd = jax.grad(lambda q: jnp.sum(
+        dot_product_attention(q, k, v, causal=True) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gd), rtol=1e-4,
+                               atol=1e-5)

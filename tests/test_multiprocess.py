@@ -49,3 +49,13 @@ def test_free_port_is_bindable():
     port = free_port()
     with socket.socket() as s:
         s.bind(("127.0.0.1", port))
+
+
+@pytest.mark.slow
+def test_failing_rank_output_is_surfaced():
+    """A rank that dies with copious output must not deadlock the launch;
+    its log tail appears in the RuntimeError (review regression: rank-order
+    pipe draining could block on a full 64KB buffer)."""
+    with pytest.raises(RuntimeError, match="ranks failed"):
+        launch_local(2, [], module="tests.helpers.noisy_rank",
+                     force_cpu=True, timeout=60)

@@ -167,3 +167,30 @@ def test_cli_parses_pipeline_schedule():
 
     c = parse_args(["--pipeline-schedule", "1f1b"], workload="bert")
     assert c.pipeline_schedule == "1f1b"
+
+
+def test_pipeline_mode_elastic_recovers(tmp_path, monkeypatch):
+    """--elastic works in -m pipeline too (review regression: the elastic
+    branch only existed in the data-mode path)."""
+    import distributed_deep_learning_tpu.train.elastic as elastic_mod
+    from distributed_deep_learning_tpu.utils.config import Config, Mode
+    from distributed_deep_learning_tpu.workloads.base import run_workload
+    from distributed_deep_learning_tpu.workloads.northstar import BERT_SPEC
+
+    monkeypatch.setenv("DDL_DATA_LIMIT", "64")
+    real_fit = elastic_mod.fit
+    calls = {"n": 0}
+
+    def flaky_fit(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("injected failure")
+        return real_fit(*args, **kwargs)
+
+    monkeypatch.setattr(elastic_mod, "fit", flaky_fit)
+    config = Config(mode=Mode.PIPELINE, num_layers=2, size=32, epochs=1,
+                    batch_size=16, num_stages=2, microbatch=8, elastic=True,
+                    checkpoint_dir=str(tmp_path / "ck"))
+    _, history = run_workload(BERT_SPEC, config)
+    assert calls["n"] == 2
+    assert "test" in [h.phase for h in history]
