@@ -134,6 +134,8 @@ class Config:
     double_softmax: bool = False        # reference quirk Q4 (Softmax + CE); off → logits+CE
     sync_in_local_data_mode: bool = True  # reference quirk Q1 fixed by default
     zero: str = "none"                  # optimizer/param sharding: none|1|fsdp
+    grad_compress: str = "none"         # gradient all-reduce wire format:
+                                        #   none|bf16|int8 (train/compress.py)
     grad_accum: int = 1                 # gradient-accumulation microsteps
     dropout: float = 0.0                # train-time dropout rate (north-star models)
     remat: bool = False                 # rematerialise activations in backward
@@ -146,6 +148,8 @@ class Config:
     pipeline_schedule: str = "gpipe"    # gpipe | 1f1b (SPMD pipeline mode)
     lr_schedule: str = "none"           # none|cosine|rsqrt|step (north stars)
     warmup_steps: int | None = None     # cosine/rsqrt warmup; None = 5% auto
+    clip_norm: float | None = None      # global-norm gradient clipping
+    metrics_file: str | None = None     # JSONL event sink (rank 0)
     elastic: bool = False               # checkpointed restart on failure
     heartbeat_dir: str | None = None    # shared dir for liveness heartbeats
     heartbeat_timeout: float = 30.0     # seconds before a peer counts as dead
@@ -232,6 +236,12 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
     p.add_argument("--zero", choices=["none", "1", "fsdp"], default="none",
                    help="shard optimizer state (ZeRO-1) or params+optimizer "
                         "(fsdp) over the fsdp/data mesh axes")
+    p.add_argument("--grad-compress", choices=["none", "bf16", "int8"],
+                   default="none",
+                   help="compress the data-parallel gradient all-reduce: "
+                        "bf16 halves wire bytes; int8 is common-scale "
+                        "quantization with int32 reduction (EQuARX-style "
+                        "numerics)")
     p.add_argument("--checkpoint-dir", type=str, default=None)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--profile-dir", type=str, default=None)
@@ -255,6 +265,13 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
     p.add_argument("--warmup", dest="warmup_steps", type=int, default=None,
                    help="warmup steps for --schedule cosine/rsqrt "
                         "(default: 5%% of total steps; 0 disables warmup)")
+    p.add_argument("--clip-norm", type=float, default=None,
+                   help="clip gradients to this global norm before the "
+                        "optimizer update (per-stage norm in staged MPMD "
+                        "modes)")
+    p.add_argument("--metrics-file", type=str, default=None,
+                   help="append one JSON object per phase/metric event "
+                        "(structured sibling of the reference log stream)")
     p.add_argument("--pipeline-schedule", choices=["gpipe", "1f1b"],
                    default="gpipe",
                    help="SPMD pipeline schedule (-m pipeline, "
@@ -306,6 +323,7 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         double_softmax=args.double_softmax,
         sync_in_local_data_mode=args.sync,
         zero=args.zero,
+        grad_compress=args.grad_compress,
         grad_accum=args.grad_accum,
         dropout=args.dropout,
         remat=args.remat,
@@ -318,6 +336,8 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         pipeline_schedule=args.pipeline_schedule,
         lr_schedule=args.lr_schedule,
         warmup_steps=args.warmup_steps,
+        clip_norm=args.clip_norm,
+        metrics_file=args.metrics_file,
         elastic=args.elastic,
         heartbeat_dir=args.heartbeat_dir,
         heartbeat_timeout=args.heartbeat_timeout,

@@ -387,6 +387,10 @@ def _run_spmd_pipelined(spec: WorkloadSpec, config: Config, devices, logger,
     if config.dropout > 0:
         raise ValueError("pipeline mode trains a deterministic trunk; "
                          "--dropout is not supported here (use -m data)")
+    if config.grad_compress != "none":
+        raise ValueError("--grad-compress targets the pure data-parallel "
+                         "gradient all-reduce; the SPMD pipeline's gradient "
+                         "dataflow is stage-sharded (use -m data)")
     dp = n_dev // n_stages
     mesh = build_mesh({"data": dp, "stage": n_stages},
                       devices[:dp * n_stages])
@@ -449,8 +453,16 @@ def run_workload(spec: WorkloadSpec, config: Config
     """Train `spec` under `config`; returns (final state, phase history)."""
     initialize_runtime(config)
     devices = _devices(config)
-    logger = PhaseLogger(verbose=is_coordinator())
+    logger = PhaseLogger(verbose=is_coordinator(),
+                         jsonl_path=config.metrics_file)
+    try:
+        return _run_workload(spec, config, devices, logger)
+    finally:
+        logger.close()
 
+
+def _run_workload(spec: WorkloadSpec, config: Config, devices, logger
+                  ) -> tuple[Any, list[EpochResult]]:
     dataset = spec.build_dataset(config)
     # DDL_DATA_LIMIT caps the examples considered (CI / smoke runs)
     import os
@@ -461,6 +473,11 @@ def run_workload(spec: WorkloadSpec, config: Config
     loss_fn = spec.build_loss(config)
     epoch_steps = max(1, len(splits.train) // config.batch_size)
     tx = spec.build_optimizer(config, epoch_steps)
+    if config.clip_norm:
+        # applied before the optimizer transform; in staged MPMD modes the
+        # per-stage updates make this a per-stage norm (documented on the
+        # flag) — global-norm semantics hold for every sharded-step path
+        tx = optax.chain(optax.clip_by_global_norm(config.clip_norm), tx)
     rng = jax.random.key(config.seed)
 
     if config.mode is Mode.PIPELINE and spec.build_pipelined is not None:
@@ -530,7 +547,21 @@ def run_workload(spec: WorkloadSpec, config: Config
                 else fsdp_state_spec
             state_spec = make_spec(state, mesh, axis=axis)
         state = place_state(state, mesh, state_spec)
-        if config.grad_accum > 1:
+        if config.grad_compress != "none":
+            if config.zero != "none" or config.grad_accum > 1 \
+                    or mesh.shape.get("model", 1) > 1 \
+                    or mesh.shape.get("expert", 1) > 1:
+                raise ValueError(
+                    "--grad-compress applies to the pure data-parallel "
+                    "gradient all-reduce; it does not compose with "
+                    "--zero/--grad-accum/--mesh model/expert axes")
+            from distributed_deep_learning_tpu.train.compress import (
+                make_compressed_step_fns)
+
+            train_step, eval_step = make_compressed_step_fns(
+                mesh, loss_fn, method=config.grad_compress,
+                remat=config.remat)
+        elif config.grad_accum > 1:
             from distributed_deep_learning_tpu.train.accumulate import (
                 make_accum_step_fns)
 
@@ -573,7 +604,8 @@ def run_workload(spec: WorkloadSpec, config: Config
                    (config.zero != "none", "--zero"),
                    (config.dropout > 0, "--dropout"),
                    (config.elastic, "--elastic"),
-                   (config.heartbeat_dir, "--heartbeat-dir")]
+                   (config.heartbeat_dir, "--heartbeat-dir"),
+                   (config.grad_compress != "none", "--grad-compress")]
     bad = [flag for cond, flag in unsupported if cond]
     if bad:
         raise ValueError(

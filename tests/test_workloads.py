@@ -127,3 +127,35 @@ def test_cli_defaults_match_reference():
 def test_unknown_workload_raises():
     with pytest.raises(ValueError):
         get_spec("resnet9000")
+
+
+def test_clip_norm_and_metrics_file(tmp_path, monkeypatch):
+    """--clip-norm trains; --metrics-file leaves a parseable JSONL event
+    stream (phase begins/ends + throughput counters)."""
+    import json
+
+    import numpy as np
+
+    from distributed_deep_learning_tpu.utils.config import Config, Mode
+    from distributed_deep_learning_tpu.workloads import get_spec
+
+    monkeypatch.setenv("DDL_DATA_LIMIT", "256")
+    mf = tmp_path / "metrics.jsonl"
+    config = Config(mode=Mode.DATA, epochs=1, batch_size=64, clip_norm=1.0,
+                    metrics_file=str(mf))
+    _, history = run_workload(get_spec("mlp"), config)
+    assert np.isfinite(history[0].loss)
+    events = [json.loads(ln) for ln in mf.read_text().splitlines()]
+    kinds = {e["event"] for e in events}
+    assert {"phase_begin", "phase_end", "metrics"} <= kinds
+    ends = [e for e in events if e["event"] == "phase_end"
+            and e.get("phase") == "train"]
+    assert ends and "accuracy" in ends[0] and "loss" in ends[0]
+
+
+def test_cli_parses_clip_and_metrics_flags():
+    from distributed_deep_learning_tpu.utils.config import parse_args
+
+    c = parse_args(["--clip-norm", "0.5", "--metrics-file", "/tmp/m.jsonl"],
+                   workload="mlp")
+    assert c.clip_norm == 0.5 and c.metrics_file == "/tmp/m.jsonl"
