@@ -13,6 +13,7 @@ import subprocess
 import sys
 
 import jax
+import pytest
 
 import __graft_entry__ as hooks
 
@@ -116,3 +117,106 @@ def test_bench_fallback_no_recursion(monkeypatch):
         assert "boom" in str(exc)
     else:
         raise AssertionError("second-level failure must re-raise, not loop")
+
+
+def test_bench_orchestrator_backoff(monkeypatch):
+    """Two hung TPU attempts skip straight to the CPU attempt; a passing
+    attempt relays its JSON line and stops."""
+    import bench
+
+    calls = []
+
+    def fake_run(cmd, env=None, timeout=None, **kw):
+        calls.append((env.get("BENCH_BATCH_PER_CHIP"),
+                      env.get("BENCH_CPU_FALLBACK")))
+        if env.get("BENCH_CPU_FALLBACK") == "1":
+            class R:
+                returncode = 0
+                stdout = '{"metric": "m", "value": 1}\n'
+            return R()
+        raise subprocess.TimeoutExpired(cmd, timeout)
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.delenv("BENCH_BATCH", raising=False)
+    monkeypatch.delenv("BENCH_BATCH_PER_CHIP", raising=False)
+    assert bench.orchestrate() == 0
+    # 256 timeout, 128 timeout, 64 SKIPPED (hung transport), then cpu
+    assert calls == [("256", None), ("128", None), (None, "1")]
+
+
+def test_bench_orchestrator_first_attempt_wins(monkeypatch):
+    import bench
+
+    calls = []
+
+    def fake_run(cmd, env=None, timeout=None, **kw):
+        calls.append(env.get("BENCH_BATCH_PER_CHIP"))
+
+        class R:
+            returncode = 0
+            stdout = '{"metric": "m", "value": 2}\n'
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.delenv("BENCH_BATCH", raising=False)
+    monkeypatch.delenv("BENCH_BATCH_PER_CHIP", raising=False)
+    assert bench.orchestrate() == 0
+    assert calls == ["256"]
+
+
+def test_bench_orchestrator_respects_pinned_batch(monkeypatch):
+    import bench
+
+    calls = []
+
+    def fake_run(cmd, env=None, timeout=None, **kw):
+        calls.append(env.get("BENCH_BATCH"))
+
+        class R:
+            returncode = 0
+            stdout = '{"metric": "m", "value": 3}\n'
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setenv("BENCH_BATCH", "32")
+    assert bench.orchestrate() == 0
+    assert calls == ["32"]
+
+
+def test_bench_cpu_attempt_strips_batch_pins(monkeypatch):
+    """A TPU-sized BENCH_BATCH pin must not reach the guaranteed CPU
+    fallback attempt."""
+    import bench
+
+    calls = []
+
+    def fake_run(cmd, env=None, timeout=None, **kw):
+        calls.append((env.get("BENCH_BATCH"), env.get("BENCH_CPU_FALLBACK")))
+        if env.get("BENCH_CPU_FALLBACK") == "1":
+            class R:
+                returncode = 0
+                stdout = '{"metric": "m", "value": 1}\n'
+            return R()
+        raise subprocess.TimeoutExpired(cmd, timeout)
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setenv("BENCH_BATCH", "2048")
+    assert bench.orchestrate() == 0
+    assert calls == [("2048", None), (None, "1")]
+
+
+def test_bench_worker_fails_fast_on_init_error(monkeypatch):
+    """Under the orchestrator (BENCH_WORKER=1) an init failure must raise,
+    not spawn a grandchild that escapes the watchdog."""
+    import bench
+
+    monkeypatch.setenv("BENCH_WORKER", "1")
+    monkeypatch.delenv("BENCH_CPU_FALLBACK", raising=False)
+    monkeypatch.setattr(jax, "devices",
+                        lambda *a: (_ for _ in ()).throw(RuntimeError("down")))
+    called = {}
+    monkeypatch.setattr(subprocess, "call",
+                        lambda *a, **k: called.setdefault("spawned", True))
+    with pytest.raises(RuntimeError, match="down"):
+        bench._devices_or_cpu_fallback()
+    assert "spawned" not in called
