@@ -18,8 +18,10 @@ Design notes (documented divergences, both TPU-first):
 * The head is untied (no weight sharing with the embedding): a tied head
   would have to reference embedding parameters across the pipeline
   boundary, forcing an extra gather per step.
-* The trunk is deterministic (dropout 0 inside the pipeline); ``--dropout``
-  therefore only rejects, never silently degrades.
+* Dropout: the pipeline derives a per-(stage, microbatch) PRNG key each
+  tick, so ``--dropout`` works under the GPipe schedule (the hand-rolled
+  1F1B backward replays forward with recompute and stays deterministic —
+  it rejects dropout instead).
 
 The object is not an ``nn.Module``: it owns three Flax sub-models and
 exposes the package's ``TrainState`` calling convention directly
@@ -89,13 +91,14 @@ class PipelinedLM:
                  head_take: Optional[tuple[int, int]] = None,
                  microbatch_size: Optional[int] = None,
                  max_len: int = 4096, dtype: jnp.dtype = jnp.float32,
-                 attention_fn=None):
+                 attention_fn=None, dropout_rate: float = 0.0):
         self.embed = LMEmbed(vocab_size, d_model, max_len, dtype)
         self.trunk = PipelinedTrunk(num_layers, mesh, num_heads=num_heads,
                                     mlp_dim=mlp_dim, causal=causal,
                                     dtype=dtype,
                                     microbatch_size=microbatch_size,
-                                    attention_fn=attention_fn)
+                                    attention_fn=attention_fn,
+                                    dropout_rate=dropout_rate)
         self.head = LMHead(vocab_size, head_take, dtype)
 
     def init(self, rng: jax.Array, tokens: jnp.ndarray) -> dict[str, Any]:
@@ -110,7 +113,8 @@ class PipelinedLM:
                  rngs=None):
         """→ (logits, model_state, aux) — the ``TrainState`` convention."""
         x = self.embed.apply({"params": params["embed"]}, tokens)
-        x = self.trunk.apply(params["trunk"], x)
+        rng = rngs.get("dropout") if (train and rngs) else None
+        x = self.trunk.apply(params["trunk"], x, rng=rng)
         logits = self.head.apply({"params": params["head"]}, x)
         return logits, model_state, jnp.zeros((), jnp.float32)
 

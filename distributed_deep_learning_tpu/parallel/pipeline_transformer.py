@@ -31,9 +31,11 @@ from distributed_deep_learning_tpu.parallel.spmd_pipeline import (
 class TrunkStage(nn.Module):
     """``layers_per_stage`` consecutive pre-LN blocks — one pipeline stage.
 
-    Dropout is 0 inside the pipeline (stochasticity would need per-stage
-    PRNG threading through shard_map; deterministic trunks match the
-    framework's seed contract).  ``attention_fn`` plugs the Pallas flash
+    Train-time stochasticity: the pipeline derives a per-(stage,
+    microbatch) PRNG key (``spmd_pipeline``'s ``rng``), handed to
+    ``apply`` as the ``dropout`` stream — Flax then folds it per Dropout
+    site, so masks are distinct across stages, blocks and microbatches yet
+    deterministic per seed.  ``attention_fn`` plugs the Pallas flash
     kernel into every block (padding masks are not threaded through the
     pipeline — pad to microbatch boundaries instead).
     """
@@ -44,15 +46,17 @@ class TrunkStage(nn.Module):
     causal: bool = False
     dtype: jnp.dtype = jnp.float32
     attention_fn: object = None
+    dropout_rate: float = 0.0
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = False):
         for i in range(self.layers_per_stage):
             x = TransformerLayer(self.num_heads, self.mlp_dim,
-                                 dropout_rate=0.0, causal=self.causal,
+                                 dropout_rate=self.dropout_rate,
+                                 causal=self.causal,
                                  dtype=self.dtype,
                                  attention_fn=self.attention_fn,
-                                 name=f"block_{i}")(x)
+                                 name=f"block_{i}")(x, train=train)
         return x
 
 
@@ -63,7 +67,7 @@ class PipelinedTrunk:
                  mlp_dim: int = 2048, causal: bool = False,
                  dtype: jnp.dtype = jnp.float32,
                  microbatch_size: Optional[int] = None,
-                 attention_fn=None):
+                 attention_fn=None, dropout_rate: float = 0.0):
         self.mesh = mesh
         self.n_stages = mesh.shape["stage"]
         if num_layers % self.n_stages:
@@ -71,7 +75,8 @@ class PipelinedTrunk:
                              f"{self.n_stages} stages")
         self.microbatch_size = microbatch_size
         self.stage = TrunkStage(num_layers // self.n_stages, num_heads,
-                                mlp_dim, causal, dtype, attention_fn)
+                                mlp_dim, causal, dtype, attention_fn,
+                                dropout_rate)
 
     def init(self, rng: jax.Array, example: jnp.ndarray) -> Any:
         """Stacked per-stage params (leading dim = stage; shard it)."""
@@ -85,8 +90,20 @@ class PipelinedTrunk:
         schedules (GPipe scan and 1F1B) apply per tick."""
         return lambda p, a: self.stage.apply({"params": p}, a)
 
-    def apply(self, stacked_params: Any, x: jnp.ndarray) -> jnp.ndarray:
-        """(B, T, d) → (B, T, d) through all stages, pipelined."""
+    def stage_fn_train(self):
+        """Stochastic variant ``(params, x, key) -> y`` for runs with
+        dropout (the pipeline derives ``key`` per stage+microbatch)."""
+        return lambda p, a, key: self.stage.apply(
+            {"params": p}, a, train=True, rngs={"dropout": key})
+
+    def apply(self, stacked_params: Any, x: jnp.ndarray,
+              rng: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        """(B, T, d) → (B, T, d) through all stages, pipelined; pass
+        ``rng`` to activate dropout."""
+        if rng is not None:
+            return spmd_pipeline(
+                self.stage_fn_train(), stacked_params, x, mesh=self.mesh,
+                microbatch_size=self.microbatch_size, rng=rng)
         return spmd_pipeline(
             self.stage_fn(), stacked_params, x, mesh=self.mesh,
             microbatch_size=self.microbatch_size)
@@ -94,7 +111,7 @@ class PipelinedTrunk:
     def apply_sequential(self, stacked_params: Any, x: jnp.ndarray
                          ) -> jnp.ndarray:
         """Reference semantics: the same stages applied one after another
-        without the pipeline (for equivalence tests)."""
+        without the pipeline (for equivalence tests; deterministic)."""
         for s in range(self.n_stages):
             p = jax.tree.map(lambda l, s=s: l[s], stacked_params)
             x = self.stage.apply({"params": p}, x)

@@ -51,7 +51,8 @@ def stack_stage_params(params_list: list[Any]) -> Any:
 
 def spmd_pipeline(stage_fn: StageFn, stacked_params: Any, x: jnp.ndarray, *,
                   mesh: Mesh, microbatch_size: int | None = None,
-                  axis: str = "stage", batch_axes: tuple[str, ...] = ("data", "fsdp")
+                  axis: str = "stage", batch_axes: tuple[str, ...] = ("data", "fsdp"),
+                  rng: jnp.ndarray | None = None
                   ) -> jnp.ndarray:
     """Run `x` through S pipelined applications of `stage_fn`.
 
@@ -64,6 +65,11 @@ def spmd_pipeline(stage_fn: StageFn, stacked_params: Any, x: jnp.ndarray, *,
         inside the same program.
       microbatch_size: reference ``-p`` semantics (microbatch SIZE); default
         one microbatch per stage.
+      rng: optional PRNG key enabling train-time stochasticity: each tick
+        calls ``stage_fn(params, x, key)`` with a key derived from
+        (stage, microbatch) — deterministic given ``rng``, distinct per
+        stage and microbatch, and stable under the scan transpose (the
+        backward replays the same keys).
     """
     S = mesh.shape[axis]
     B = x.shape[0]
@@ -101,7 +107,17 @@ def spmd_pipeline(stage_fn: StageFn, stacked_params: Any, x: jnp.ndarray, *,
             inp0 = lax.dynamic_index_in_dim(
                 xs, jnp.clip(t, 0, M - 1), keepdims=False)
             inp = jnp.where(stage == 0, inp0, carry)
-            out = stage_fn(params, inp)
+            if rng is not None:
+                m_idx = jnp.clip(t - stage, 0, M - 1)
+                key = jax.random.fold_in(jax.random.fold_in(rng, stage),
+                                         m_idx)
+                # distinct masks per data shard too, not just per stage/mb
+                for a in batch_axes:
+                    if mesh.shape.get(a, 1) > 1:
+                        key = jax.random.fold_in(key, lax.axis_index(a))
+                out = stage_fn(params, inp, key)
+            else:
+                out = stage_fn(params, inp)
             nxt = lax.ppermute(out, axis,
                                [(i, (i + 1) % S) for i in range(S)])
             return nxt, out

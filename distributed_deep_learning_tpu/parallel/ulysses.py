@@ -1,0 +1,103 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence resharding.
+
+The second of the two standard context-parallel schemes (the first, ring
+attention, lives in :mod:`.ring_attention`): activations arrive sharded
+over the SEQUENCE axis (each device owns T/S contiguous tokens of every
+head); two ``all_to_all`` collectives reshape that into a HEAD sharding
+(each device owns H/S full-sequence heads), attention runs locally as
+plain full attention per head group, and a mirror all-to-all restores the
+sequence sharding for the (sequence-local) MLP that follows.
+
+Trade-offs vs ring attention, both exact:
+
+* Ulysses sends activations twice (two all-to-alls of the full q/k/v/o
+  volume) but computes attention in ONE dense local call — best when heads
+  divide nicely over devices and the fused-kernel path matters (the local
+  call can be the Pallas flash kernel).
+* Ring keeps activations put and rotates K/V S times — communication
+  proportional to K/V only, any head count, but the attention is an S-hop
+  software pipeline.
+
+The reference has no sequence parallelism of any kind (SURVEY.md §2.5);
+both schemes here shard over the same declared ``seq`` mesh axis, so they
+are drop-in alternatives behind the same model plumbing.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # JAX >= 0.7 exports shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                      mesh: Mesh, axis: str = "seq", causal: bool = False,
+                      attention_fn=None) -> jnp.ndarray:
+    """Exact attention on ``(B, T, H, D)`` q/k/v sharded over ``axis`` in T.
+
+    ``attention_fn(q, k, v, causal=..., dtype=...)`` runs the local
+    full-sequence attention per head group (default: the package's dense
+    softmax; pass the flash adapter for the fused kernel).
+    """
+    S = mesh.shape[axis]
+    B, T, H, D = q.shape
+    if H % S:
+        raise ValueError(f"{H} heads not divisible over {axis}={S} "
+                         "(use ring attention for head counts the mesh "
+                         "does not divide)")
+
+    if attention_fn is None:
+        from distributed_deep_learning_tpu.models.transformer import (
+            dot_product_attention)
+
+        attention_fn = dot_product_attention
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+             out_specs=P(None, axis), check_vma=False)
+    def run(q, k, v):
+        # local shapes: (B, T/S, H, D) — sequence-sharded, all heads
+        def to_heads(x):
+            # all_to_all: scatter the head axis, gather the sequence axis
+            # → (B, T, H/S, D): full sequence, head-sharded
+            return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+        qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+        oh = attention_fn(qh, kh, vh, causal=causal, dtype=qh.dtype)
+        # mirror: scatter sequence back, gather heads
+        return lax.all_to_all(oh, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    return run(q, k, v)
+
+
+def make_attention_fn(mesh: Mesh, axis: str = "seq", causal: bool = False,
+                      inner=None):
+    """Adapter: Ulysses SP as a ``MultiHeadAttention.attention_fn``
+    (mirrors the ring and flash adapters).  ``inner`` optionally selects
+    the local kernel (e.g. the flash adapter) — composition the ring
+    scheme cannot offer."""
+    forced_causal = causal
+
+    def attn(q, k, v, *, mask=None, key_valid=None, causal=False,
+             dtype=jnp.float32):
+        if mask is not None or key_valid is not None:
+            raise NotImplementedError(
+                "ulysses attention does not thread padding masks through "
+                "the all-to-all (pad to block boundaries instead)")
+        out = ulysses_attention(q, k, v, mesh=mesh, axis=axis,
+                                causal=causal or forced_causal,
+                                attention_fn=inner)
+        return out.astype(dtype)
+
+    return attn

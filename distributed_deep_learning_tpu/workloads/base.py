@@ -384,9 +384,10 @@ def _run_spmd_pipelined(spec: WorkloadSpec, config: Config, devices, logger,
     if n_dev % n_stages:
         raise ValueError(f"--nstages {n_stages} must divide the device "
                          f"count {n_dev} (the rest becomes the data axis)")
-    if config.dropout > 0:
-        raise ValueError("pipeline mode trains a deterministic trunk; "
-                         "--dropout is not supported here (use -m data)")
+    if config.dropout > 0 and config.pipeline_schedule == "1f1b":
+        raise ValueError("--pipeline-schedule 1f1b recomputes forward in "
+                         "its hand-rolled backward and stays deterministic; "
+                         "--dropout needs the gpipe schedule (or -m data)")
     if config.grad_compress != "none":
         raise ValueError("--grad-compress targets the pure data-parallel "
                          "gradient all-reduce; the SPMD pipeline's gradient "
@@ -408,8 +409,11 @@ def _run_spmd_pipelined(spec: WorkloadSpec, config: Config, devices, logger,
         config = config.replace(microbatch=snapped)
 
     model = spec.build_pipelined(config, dataset, mesh)
+    train_rng = (jax.random.key(config.seed + 1)
+                 if config.dropout > 0 else None)
     state = TrainState.create(apply_fn=model.apply_fn,
-                              params=model.init(rng, example), tx=tx)
+                              params=model.init(rng, example), tx=tx,
+                              rng=train_rng)
     state_spec = tp_state_spec(state, model.shard_rules)
     state = place_state(state, mesh, state_spec)
     train_step, eval_step = make_step_fns(mesh, loss_fn,
@@ -426,7 +430,8 @@ def _run_spmd_pipelined(spec: WorkloadSpec, config: Config, devices, logger,
     if config.elastic:
         def make_state():
             s = TrainState.create(apply_fn=model.apply_fn,
-                                  params=model.init(rng, example), tx=tx)
+                                  params=model.init(rng, example), tx=tx,
+                                  rng=train_rng)
             return place_state(s, mesh, state_spec)
 
         return _fit_elastic(config, logger, make_state, train_step,

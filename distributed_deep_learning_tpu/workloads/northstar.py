@@ -201,7 +201,8 @@ def _transformer_pipelined(config: Config, dataset, mesh):
                        causal=True, head_take=(src_len - 1, tgt_len),
                        microbatch_size=config.microbatch,
                        dtype=config_dtype(config),
-                       attention_fn=_attention_fn(config))
+                       attention_fn=_attention_fn(config),
+                       dropout_rate=config.dropout)
 
 
 def _transformer_layers(config: Config, dataset):
@@ -278,7 +279,8 @@ def _bert_pipelined(config: Config, dataset, mesh):
                        mesh=mesh, causal=False,
                        microbatch_size=config.microbatch,
                        dtype=config_dtype(config),
-                       attention_fn=_attention_fn(config))
+                       attention_fn=_attention_fn(config),
+                       dropout_rate=config.dropout)
 
 
 def _bert_layers(config: Config, dataset):

@@ -63,14 +63,6 @@ def test_pipeline_learning_progress(monkeypatch):
     assert train_losses[-1] < train_losses[0]
 
 
-def test_pipeline_rejects_dropout(monkeypatch):
-    monkeypatch.setenv("DDL_DATA_LIMIT", "32")
-    config = Config(mode=Mode.PIPELINE, num_layers=2, size=32, epochs=1,
-                    batch_size=16, num_stages=2, dropout=0.1)
-    with pytest.raises(ValueError, match="dropout"):
-        run_workload(BERT_SPEC, config)
-
-
 def test_pipeline_snaps_incompatible_microbatch(monkeypatch):
     """-p sizes that don't divide batch / data-parallel degree are snapped
     to the nearest valid size instead of crashing in spmd_pipeline."""
@@ -151,3 +143,45 @@ def test_mpmd_staged_rejects_unsupported_flags(monkeypatch):
         run_workload(RESNET_SPEC, Config(**base, checkpoint_dir="/tmp/x"))
     with pytest.raises(ValueError, match="--zero"):
         run_workload(RESNET_SPEC, Config(**base, zero="1"))
+
+
+def test_pipeline_dropout_trains_and_is_seeded(monkeypatch):
+    """--dropout works under the GPipe pipeline schedule: per-(stage,
+    microbatch) PRNG keys, deterministic per seed, distinct from the
+    no-dropout run."""
+    monkeypatch.setenv("DDL_DATA_LIMIT", "64")
+    base = dict(mode=Mode.PIPELINE, num_layers=2, size=32, epochs=1,
+                batch_size=16, num_stages=2, microbatch=8)
+    _, h1 = run_workload(BERT_SPEC, Config(**base, dropout=0.2))
+    _, h2 = run_workload(BERT_SPEC, Config(**base, dropout=0.2))
+    _, h0 = run_workload(BERT_SPEC, Config(**base))
+    l1 = [h.loss for h in h1 if h.phase == "train"]
+    l2 = [h.loss for h in h2 if h.phase == "train"]
+    l0 = [h.loss for h in h0 if h.phase == "train"]
+    assert l1 == l2                      # seeded: identical reruns
+    assert l1 != l0                      # dropout actually perturbs
+    assert all(np.isfinite(v) for v in l1)
+
+
+def test_pipeline_dropout_rejected_under_1f1b(monkeypatch):
+    monkeypatch.setenv("DDL_DATA_LIMIT", "32")
+    config = Config(mode=Mode.PIPELINE, num_layers=2, size=32, epochs=1,
+                    batch_size=16, num_stages=2, dropout=0.1,
+                    pipeline_schedule="1f1b")
+    with pytest.raises(ValueError, match="1f1b"):
+        run_workload(BERT_SPEC, config)
+
+
+def test_pipeline_elastic_keeps_dropout_rng(tmp_path, monkeypatch):
+    """--elastic -m pipeline --dropout: the recovery path's fresh states
+    carry the dropout PRNG (review regression: make_state dropped it)."""
+    monkeypatch.setenv("DDL_DATA_LIMIT", "64")
+    base = dict(mode=Mode.PIPELINE, num_layers=2, size=32, epochs=1,
+                batch_size=16, num_stages=2, microbatch=8, dropout=0.2)
+    _, h_plain = run_workload(BERT_SPEC, Config(**base))
+    _, h_elastic = run_workload(
+        BERT_SPEC, Config(**base, elastic=True,
+                          checkpoint_dir=str(tmp_path / "ck")))
+    lp = [h.loss for h in h_plain if h.phase == "train"]
+    le = [h.loss for h in h_elastic if h.phase == "train"]
+    assert lp == le  # same seeded dropout stream on both paths

@@ -1,0 +1,121 @@
+"""Ulysses (all-to-all) sequence parallelism vs full attention — the
+second context-parallel scheme next to ring attention (SURVEY.md §2.5
+lists SP/CP as absent from the reference)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_deep_learning_tpu.parallel.ring_attention import (
+    full_attention, ring_attention)
+from distributed_deep_learning_tpu.parallel.ulysses import (make_attention_fn,
+                                                            ulysses_attention)
+from distributed_deep_learning_tpu.runtime.mesh import build_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh_seq8():
+    return build_mesh({"seq": 8})
+
+
+def _qkv(B=2, T=32, H=8, D=16, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    return tuple(jax.random.normal(k, (B, T, H, D)) for k in ks)
+
+
+def test_matches_full_attention(mesh_seq8):
+    q, k, v = _qkv()
+    with mesh_seq8:
+        got = jax.jit(lambda q, k, v: ulysses_attention(
+            q, k, v, mesh=mesh_seq8))(q, k, v)
+    expected = full_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_matches_full_attention_causal(mesh_seq8):
+    q, k, v = _qkv(seed=1)
+    with mesh_seq8:
+        got = jax.jit(lambda q, k, v: ulysses_attention(
+            q, k, v, mesh=mesh_seq8, causal=True))(q, k, v)
+    expected = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_matches_ring_attention(mesh_seq8):
+    """Both context-parallel schemes compute the same exact attention."""
+    q, k, v = _qkv(seed=2)
+    with mesh_seq8:
+        u = jax.jit(lambda q, k, v: ulysses_attention(
+            q, k, v, mesh=mesh_seq8, causal=True))(q, k, v)
+        r = jax.jit(lambda q, k, v: ring_attention(
+            q, k, v, mesh=mesh_seq8, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(u), np.asarray(r), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_gradients_match(mesh_seq8):
+    q, k, v = _qkv(seed=3)
+
+    def loss_u(q, k, v):
+        return jnp.sum(ulysses_attention(q, k, v, mesh=mesh_seq8,
+                                         causal=True) ** 2)
+
+    def loss_f(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=True) ** 2)
+
+    with mesh_seq8:
+        gu = jax.jit(jax.grad(loss_u, argnums=(0, 1, 2)))(q, k, v)
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gu, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_indivisible_heads_raise(mesh_seq8):
+    q, k, v = _qkv(H=4)  # 4 heads over 8 devices
+    with pytest.raises(ValueError, match="heads"):
+        ulysses_attention(q, k, v, mesh=mesh_seq8)
+
+
+def test_flash_inner_kernel(mesh_seq8):
+    """The local attention can be the Pallas flash kernel (interpret mode
+    on CPU) — the fused-kernel composition ring attention cannot offer."""
+    from distributed_deep_learning_tpu.ops import attention_pallas
+
+    q, k, v = _qkv(seed=4)
+    inner = attention_pallas.make_attention_fn(block_q=8, block_k=8)
+    with mesh_seq8:
+        got = jax.jit(lambda q, k, v: ulysses_attention(
+            q, k, v, mesh=mesh_seq8, causal=True,
+            attention_fn=lambda qq, kk, vv, causal, dtype: inner(
+                qq, kk, vv, causal=causal, dtype=dtype)))(q, k, v)
+    expected = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_layer_adapter(mesh_seq8):
+    """Plugs into MultiHeadAttention like the ring/flash adapters."""
+    from distributed_deep_learning_tpu.models.transformer import (
+        TransformerLayer)
+
+    x = jax.random.normal(jax.random.key(5), (2, 32, 64))
+    dense_layer = TransformerLayer(num_heads=8, mlp_dim=128)
+    sp_layer = TransformerLayer(num_heads=8, mlp_dim=128,
+                                attention_fn=make_attention_fn(mesh_seq8))
+    params = dense_layer.init(jax.random.key(0), x)
+    with mesh_seq8:
+        got = jax.jit(lambda p, x: sp_layer.apply(p, x))(params, x)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(dense_layer.apply(params, x)),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_adapter_rejects_masks(mesh_seq8):
+    fn = make_attention_fn(mesh_seq8)
+    q, k, v = _qkv(seed=6)
+    with pytest.raises(NotImplementedError):
+        fn(q, k, v, key_valid=jnp.ones((2, 32), bool))
