@@ -1,0 +1,154 @@
+"""Fused linear + cross-entropy: the LM loss without (N, V) logits.
+
+For a language model the output projection is the memory hot spot: logits
+are ``(batch·seq, vocab)`` — at BERT/WMT scale (V = 30-32k) they dwarf
+every activation in the network, and the standard path materialises them
+TWICE (forward value + softmax in the backward).  This op fuses the
+projection matmul with the cross-entropy reduction, scanning over vocab
+blocks:
+
+  forward   — per block: ``logits_blk = h @ W_blk`` (MXU-shaped), fold
+              into running (max, sumexp) online-logsumexp accumulators and
+              pick out each row's target logit when it falls in the block.
+              Peak extra memory: ``(N, block)`` instead of ``(N, V)``.
+  backward  — ``custom_vjp`` recomputes each block's logits and folds
+              ``softmax_blk - onehot_blk`` into ``dh`` / ``dW`` block by
+              block; same ``(N, block)`` bound.
+
+This is the same blockwise-recompute trade the flash-attention kernel
+makes for the (T, T) score matrix, applied to the (N, V) logit matrix —
+plain ``lax.scan`` + matmuls rather than Pallas, because a scan of
+MXU-shaped matmuls with fused elementwise tails is already the efficient
+TPU schedule for this op.
+
+Semantics match :func:`..train.objectives.token_cross_entropy`'s
+convention: ``targets == ignore_id`` positions contribute nothing; the
+result is the mean loss over the counted positions.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_count(V: int, block: int) -> int:
+    if V % block:
+        raise ValueError(f"vocab {V} must be divisible by block {block}")
+    return V // block
+
+
+def _fwd(h, table, targets, ignore_id, block):
+    """→ (per-position loss (N,), valid mask (N,)).
+
+    h: (N, d) f32/bf16; table: (V, d) — the (tied) embedding layout;
+    targets: (N,) int.
+    """
+    N, d = h.shape
+    V = table.shape[0]
+    nb = _block_count(V, block)
+    h32 = h.astype(jnp.float32)
+    w = table.astype(jnp.float32).reshape(nb, block, d)
+
+    def fold(carry, wb_i):
+        m, s, tgt_logit = carry
+        wb, i = wb_i
+        logits = h32 @ wb.T                                  # (N, block)
+        bmax = jnp.max(logits, axis=-1)
+        new_m = jnp.maximum(m, bmax)
+        s = s * jnp.exp(m - new_m) + jnp.sum(
+            jnp.exp(logits - new_m[:, None]), axis=-1)
+        # target logit if it falls inside this block
+        local = targets - i * block
+        inside = (local >= 0) & (local < block)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, block - 1)[:, None], axis=1)[:, 0]
+        tgt_logit = jnp.where(inside, picked, tgt_logit)
+        return (new_m, s, tgt_logit), None
+
+    m0 = jnp.full((N,), NEG_INF, jnp.float32)
+    s0 = jnp.zeros((N,), jnp.float32)
+    t0 = jnp.zeros((N,), jnp.float32)
+    (m, s, tgt_logit), _ = lax.scan(fold, (m0, s0, t0),
+                                    (w, jnp.arange(nb)))
+    logz = m + jnp.log(s)
+    valid = targets != ignore_id
+    return jnp.where(valid, logz - tgt_logit, 0.0), valid
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_linear_cross_entropy(h, table, targets, ignore_id: int = 0,
+                               block: int = 512):
+    """Mean cross-entropy of ``softmax(h @ table.T)`` against ``targets``
+    without materialising the (N, V) logits.
+
+    ``h`` is (..., d) activations, ``table`` (V, d) (the embedding-table
+    layout used by the tied heads in :mod:`..models.transformer`),
+    ``targets`` (...,) int ids; ``ignore_id`` positions are excluded from
+    the mean (the package's padding convention).
+    """
+    hf = h.reshape(-1, h.shape[-1])
+    tf = targets.reshape(-1)
+    losses, valid = _fwd(hf, table, tf, ignore_id, block)
+    return jnp.sum(losses) / jnp.maximum(jnp.sum(valid), 1)
+
+
+def _vjp_fwd(h, table, targets, ignore_id, block):
+    return (fused_linear_cross_entropy(h, table, targets, ignore_id, block),
+            (h, table, targets))
+
+
+def _vjp_bwd(ignore_id, block, res, g):
+    h, table, targets = res
+    shape = h.shape
+    h2 = h.reshape(-1, shape[-1]).astype(jnp.float32)
+    tf = targets.reshape(-1)
+    N, d = h2.shape
+    V = table.shape[0]
+    nb = _block_count(V, block)
+    w = table.astype(jnp.float32).reshape(nb, block, d)
+
+    # pass 1 (recompute): the normalisers
+    _, valid = _fwd(h2, table, tf, ignore_id, block)
+    # recompute logsumexp pieces (shared with _fwd; cheap relative to bwd)
+    def lse(carry, wb):
+        m, s = carry
+        logits = h2 @ wb.T
+        bmax = jnp.max(logits, axis=-1)
+        new_m = jnp.maximum(m, bmax)
+        s = s * jnp.exp(m - new_m) + jnp.sum(
+            jnp.exp(logits - new_m[:, None]), axis=-1)
+        return (new_m, s), None
+
+    (m, s), _ = lax.scan(lse, (jnp.full((N,), NEG_INF, jnp.float32),
+                               jnp.zeros((N,), jnp.float32)), w)
+    logz = m + jnp.log(s)
+    count = jnp.maximum(jnp.sum(valid), 1)
+    scale = (g / count) * valid.astype(jnp.float32)       # (N,)
+
+    # pass 2: dh and dW block by block — (softmax - onehot) folded in
+    def bwd_block(dh, wb_i):
+        wb, i = wb_i
+        logits = h2 @ wb.T
+        p = jnp.exp(logits - logz[:, None])               # softmax block
+        local = tf - i * block
+        inside = (local >= 0) & (local < block)
+        onehot = jax.nn.one_hot(jnp.where(inside, local, -1), block,
+                                dtype=jnp.float32)
+        delta = (p - onehot) * scale[:, None]             # (N, block)
+        dh = dh + delta @ wb
+        dwb = delta.T @ h2                                # (block, d)
+        return dh, dwb
+
+    dh0 = jnp.zeros_like(h2)
+    dh, dw = lax.scan(bwd_block, dh0, (w, jnp.arange(nb)))
+    return (dh.reshape(shape).astype(h.dtype),
+            dw.reshape(V, d).astype(table.dtype), None)
+
+
+fused_linear_cross_entropy.defvjp(_vjp_fwd, _vjp_bwd)

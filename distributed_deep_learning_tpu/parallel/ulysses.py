@@ -54,6 +54,9 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         raise ValueError(f"{H} heads not divisible over {axis}={S} "
                          "(use ring attention for head counts the mesh "
                          "does not divide)")
+    if T % S:
+        raise ValueError(f"sequence length {T} not divisible by "
+                         f"{axis}={S}; pad to a multiple")
 
     if attention_fn is None:
         from distributed_deep_learning_tpu.models.transformer import (

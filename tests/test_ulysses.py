@@ -119,3 +119,9 @@ def test_adapter_rejects_masks(mesh_seq8):
     q, k, v = _qkv(seed=6)
     with pytest.raises(NotImplementedError):
         fn(q, k, v, key_valid=jnp.ones((2, 32), bool))
+
+
+def test_indivisible_sequence_raises(mesh_seq8):
+    q, k, v = _qkv(T=30)
+    with pytest.raises(ValueError, match="sequence length"):
+        ulysses_attention(q, k, v, mesh=mesh_seq8)
