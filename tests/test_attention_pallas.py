@@ -145,6 +145,21 @@ def test_fully_padded_sequence_no_nan():
     assert np.isfinite(np.asarray(got)).all()
 
 
+def test_fully_padded_sequence_zero_gradients():
+    """Backward regression: with every key masked, lse = m + log(l) must not
+    let f32 absorb log(l) into NEG_INF (p would come back as 1 per key and
+    inflate dk/dv by ~Tk).  Fully-padded rows contribute zero gradient."""
+    q, k, v = _qkv(T=16, seed=13)
+    valid = jnp.zeros((2, 16), bool)
+    g = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, key_valid=valid, block_q=8, block_k=8) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for arr in g:
+        arr = np.asarray(arr)
+        assert np.isfinite(arr).all()
+        np.testing.assert_allclose(arr, np.zeros_like(arr), atol=1e-6)
+
+
 def test_bert_encoder_flash_matches_dense():
     """Model-level parity: the same BERT weights under flash and dense
     attention on padded token batches."""
