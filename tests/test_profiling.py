@@ -82,3 +82,48 @@ def test_workload_cli_profile_dir(tmp_path, monkeypatch):
     run_workload(get_spec("mlp"), parse_args(argv, workload="mlp"))
     found = [f for _, _, files in os.walk(d) for f in files]
     assert found, "profile dir empty after profiled run"
+
+
+def test_measure_async_overlap_staged_trainer():
+    """StagedTrainer's claimed cross-stage overlap, measured: the host must
+    enqueue the full microbatched stage schedule well before the devices
+    finish it (async dispatch is the mechanism that overlaps microbatch k
+    on stage s with k+1 on s-1 once stages sit on distinct chips)."""
+    import jax
+    import optax
+
+    from distributed_deep_learning_tpu.models.mlp import mlp_layer_sequence
+    from distributed_deep_learning_tpu.parallel.partition import (
+        balanced_partition)
+    from distributed_deep_learning_tpu.parallel.staging import StagedModel
+    from distributed_deep_learning_tpu.train.objectives import (
+        cross_entropy_loss)
+    from distributed_deep_learning_tpu.utils.profiling import (
+        measure_async_overlap)
+    from distributed_deep_learning_tpu.workloads.base import StagedTrainer
+
+    devices = jax.devices()[:2]
+    # wide layers so per-stage work dwarfs dispatch cost
+    layers = mlp_layer_sequence(hidden_size=1024, num_hidden_layers=4,
+                                num_classes=8)
+    assignment = balanced_partition(len(layers), len(devices))
+    staged = StagedModel.from_layers(layers, assignment, len(devices))
+    trainer = StagedTrainer(staged, devices, cross_entropy_loss,
+                            optax.sgd(0.01), microbatch_size=64)
+    x = jax.random.normal(jax.random.key(0), (256, 1024))
+    y = jax.nn.one_hot(
+        jax.random.randint(jax.random.key(1), (256,), 0, 8), 8)
+    state = trainer.init(jax.random.key(2), x[:1])
+
+    # best of 3: a single GC pause or scheduler stall between the two
+    # clock reads must not fail the suite (timing tests on a shared box)
+    runs = [measure_async_overlap(
+        lambda s: trainer.forward(s.params, s.model_state, x, train=False),
+        state) for _ in range(3)]
+    for m in runs:
+        assert m["total_s"] > 0 and 0 <= m["dispatch_s"] <= m["total_s"] * 1.01
+    best = max(runs, key=lambda m: m["overlap_fraction"])
+    # the host must be able to run ahead of the devices: in its best run,
+    # enqueueing the 4-microbatch x 2-stage schedule takes well under the
+    # execution wall time (measured ~0.06 on this box; 0.9 = generous)
+    assert best["dispatch_s"] < 0.9 * best["total_s"], runs
