@@ -217,9 +217,12 @@ def main() -> None:
     else:
         batch = 256 * n_chips if on_tpu else 8
     steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 2))
+    # space-to-depth stem (mathematically-equivalent 4x4-s1 packed conv,
+    # models/resnet.py) is the TPU default; BENCH_S2D=0 reverts
+    s2d = on_tpu and os.environ.get("BENCH_S2D", "1") != "0"
     ips, flops_per_step = _train_throughput(
-        resnet50(dtype=dtype), image_size=224, num_classes=1000,
-        batch=batch, steps=steps, mesh=mesh)
+        resnet50(dtype=dtype, stem_s2d=s2d), image_size=224,
+        num_classes=1000, batch=batch, steps=steps, mesh=mesh)
 
     mfu = flops_per_image = None
     peak = chip_peak_flops(device_kind) if on_tpu else None

@@ -95,11 +95,65 @@ class BasicBlock(nn.Module):
         return nn.relu(residual + y)
 
 
+def space_to_depth(x: jnp.ndarray, block: int = 2) -> jnp.ndarray:
+    """Pack ``block×block`` spatial patches into channels (NHWC).
+
+    ``(N, H, W, C) → (N, H/b, W/b, b²·C)`` with channel order
+    ``(row_parity, col_parity, c)`` — the layout
+    :func:`space_to_depth_stem_kernel` assumes.
+    """
+    n, h, w, c = x.shape
+    if h % block or w % block:
+        raise ValueError(f"spatial dims {(h, w)} not divisible by {block}")
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        n, h // block, w // block, block * block * c)
+
+
+def space_to_depth_stem_kernel(w7: jnp.ndarray) -> jnp.ndarray:
+    """Map a ``(7, 7, C, O)`` stride-2 stem kernel to the equivalent
+    ``(4, 4, 4C, O)`` stride-1 kernel over :func:`space_to_depth` input.
+
+    Output row ``i`` of the original conv reads input rows ``2i + (a-3)``,
+    ``a ∈ [0, 7)``; writing ``a - 3 = 2m + p`` (``p`` the row parity) gives
+    ``m ∈ [-2, 1]`` → a 4-tap stride-1 conv in the packed domain, with the
+    ``(m=-2, p=0)`` slot (``a = -1``) zero.  With padding ``(2, 1)`` the
+    outputs match the original ``padding=3`` conv exactly (equivalence
+    asserted in ``tests/test_northstar_models.py``).  The MLPerf-style TPU
+    stem: a 3-channel 7×7 conv leaves the MXU's 128-deep contraction ~2%
+    occupied; the packed 4×4×12 kernel quadruples arithmetic intensity.
+    """
+    kh, kw, c, o = w7.shape
+    if (kh, kw) != (7, 7):
+        raise ValueError(f"expected a 7x7 stem kernel, got {(kh, kw)}")
+    w4 = jnp.zeros((4, 4, 4 * c, o), w7.dtype)
+    for ua in range(4):
+        for pa in range(2):
+            a = 2 * ua + pa - 1
+            if not 0 <= a < 7:
+                continue
+            for ub in range(4):
+                for pb in range(2):
+                    b = 2 * ub + pb - 1
+                    if not 0 <= b < 7:
+                        continue
+                    ch = (pa * 2 + pb) * c
+                    w4 = w4.at[ua, ub, ch:ch + c, :].set(w7[a, b])
+    return w4
+
+
 class ResNet(nn.Module):
     """ImageNet-shaped ResNet.  ``stage_sizes``/``block_cls`` select depth.
 
     ``small_inputs=True`` swaps the 7×7-s2 + maxpool stem for a 3×3-s1 stem
     (the standard CIFAR adaptation, used by the CIFAR-10 BASELINE config).
+
+    ``stem_s2d=True`` computes the same function class with the input
+    space-to-depth-packed and the stem as the equivalent masked 4×4
+    stride-1 conv (:func:`space_to_depth_stem_kernel`; the mask pins the
+    taps outside the original 7×7 window to zero, so equivalence holds
+    under training, not just at mapped weights) — the standard TPU
+    optimisation for the MXU-hostile 3-channel 7×7 stem.
     """
 
     stage_sizes: Sequence[int] = (3, 4, 6, 3)  # ResNet-50
@@ -107,6 +161,7 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     width: int = 64
     small_inputs: bool = False
+    stem_s2d: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -116,6 +171,17 @@ class ResNet(nn.Module):
             x = nn.Conv(self.width, (3, 3), use_bias=False,
                         kernel_init=conv_init, dtype=self.dtype,
                         name="stem_conv")(x)
+        elif self.stem_s2d:
+            x = space_to_depth(x)
+            # mask the taps that fall outside the original 7x7 window
+            # (map-of-ones = 1 at valid slots): the masked conv spans
+            # EXACTLY the 7x7 stem's function class, and the mask zeroes
+            # those slots' gradients too — equivalence survives training
+            mask = space_to_depth_stem_kernel(
+                jnp.ones((7, 7, x.shape[-1] // 4, self.width)))
+            x = nn.Conv(self.width, (4, 4), padding=[(2, 1), (2, 1)],
+                        use_bias=False, kernel_init=conv_init, mask=mask,
+                        dtype=self.dtype, name="stem_conv_s2d")(x)
         else:
             x = nn.Conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
                         use_bias=False, kernel_init=conv_init,

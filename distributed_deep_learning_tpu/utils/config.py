@@ -144,6 +144,7 @@ class Config:
     profile_dir: str | None = None
     data_dir: str | None = None         # real-data root (ImageFolder layout)
     image_size: int = 224               # decode size for --data-dir images
+    stem_s2d: bool = False              # space-to-depth ResNet stem (TPU opt)
     attention: str = "auto"             # auto|dense|flash (transformer family)
     pipeline_schedule: str = "gpipe"    # gpipe | 1f1b (SPMD pipeline mode)
     lr_schedule: str = "none"           # none|cosine|rsqrt|step (north stars)
@@ -251,6 +252,11 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
                         "-w sets the decode thread count")
     p.add_argument("--image-size", type=int, default=224,
                    help="square decode size for --data-dir images")
+    p.add_argument("--stem-s2d", action="store_true",
+                   help="space-to-depth ResNet stem: pack 2x2 input patches "
+                        "into channels and run the mathematically equivalent "
+                        "4x4-s1 stem conv (MXU-friendly; ImageNet-size "
+                        "stems only)")
     p.add_argument("--attention", choices=["auto", "dense", "flash"],
                    default="auto",
                    help="attention implementation for transformer-family "
@@ -332,6 +338,7 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         profile_dir=args.profile_dir,
         data_dir=args.data_dir,
         image_size=args.image_size,
+        stem_s2d=args.stem_s2d,
         attention=args.attention,
         pipeline_schedule=args.pipeline_schedule,
         lr_schedule=args.lr_schedule,

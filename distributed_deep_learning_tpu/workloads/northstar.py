@@ -82,6 +82,7 @@ def _resnet_model(config: Config, dataset):
     return ResNet(stage_sizes=_RESNET_LAYERS[depth],
                   block_cls=BottleneckBlock if depth >= 50 else BasicBlock,
                   num_classes=num_classes, small_inputs=small,
+                  stem_s2d=config.stem_s2d and not small,
                   dtype=config_dtype(config))
 
 
