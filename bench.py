@@ -133,6 +133,71 @@ def _train_throughput(model, *, image_size, num_classes, batch, steps, mesh):
     return batch * steps / dt / n_chips, flops_per_step
 
 
+def _lm_throughput(*, batch, seq_len, steps, mesh, dtype):
+    """tokens/sec/chip + FLOPs/step for a CausalLM train step (flash
+    attention + fused linear-cross-entropy head, weight-tied)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_deep_learning_tpu.data.loader import BATCH_AXES
+    from distributed_deep_learning_tpu.models.transformer import CausalLM
+    from distributed_deep_learning_tpu.ops.attention_pallas import (
+        make_attention_fn)
+
+    n_chips = len(mesh.devices.flatten())
+    on_tpu = mesh.devices.flatten()[0].platform == "tpu"
+    model = CausalLM(vocab_size=32768, num_layers=12, d_model=768,
+                     num_heads=12, mlp_dim=3072, dtype=dtype,
+                     attention_fn=make_attention_fn() if on_tpu else None)
+    rng = np.random.default_rng(7)
+    toks = jnp.asarray(rng.integers(1, 32768, (batch, seq_len + 1)),
+                       jnp.int32)
+
+    params = model.init(jax.random.key(0), toks[:1, :-1])
+    tx = optax.adamw(1e-4)
+    opt_state = tx.init(params)
+
+    def step(params, opt_state, toks):
+        def loss_fn(p):
+            h = model.apply(p, toks[:, :-1], train=True)
+            return model.loss(p, h, toks[:, 1:])
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state2 = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state2, loss
+
+    sh = NamedSharding(mesh, P(BATCH_AXES))
+    repl = NamedSharding(mesh, P())
+    toks = jax.device_put(toks, sh)
+    params, opt_state = jax.device_put((params, opt_state), repl)
+    jstep = jax.jit(step, in_shardings=(repl, repl, sh),
+                    out_shardings=(repl, repl, repl), donate_argnums=(0, 1))
+
+    flops_per_step = None
+    run = jstep
+    try:
+        compiled = jstep.lower(params, opt_state, toks).compile()
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else {}
+        flops_per_step = float(analysis.get("flops", 0.0)) * n_chips or None
+        run = compiled
+    except Exception:
+        pass
+
+    params, opt_state, loss = run(params, opt_state, toks)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = run(params, opt_state, toks)
+    float(loss)
+    dt = time.perf_counter() - t0
+    return batch * seq_len * steps / dt / n_chips, flops_per_step
+
+
 def _attention_speedup(steps: int = 20) -> float | None:
     """Fused (Pallas flash) vs dense attention fwd+bwd at a long-context
     shape; returns flash/dense step-time ratio > 1 = flash faster.  TPU
@@ -255,6 +320,24 @@ def main() -> None:
         secondary = {"metric": "densenet_bc64 train images/sec/chip",
                      "value": round(dips, 2), "vs_baseline": round(dvs, 4)}
 
+    # --- LM: decoder-only transformer, flash attention + fused CE head -----
+    lm = None
+    if os.environ.get("BENCH_LM", "1" if on_tpu else "0") != "0":
+        lbatch = int(os.environ.get("BENCH_LM_BATCH",
+                                    8 * n_chips if on_tpu else 2))
+        lseq = int(os.environ.get("BENCH_LM_SEQ", 2048 if on_tpu else 128))
+        lsteps = int(os.environ.get("BENCH_LM_STEPS", 10 if on_tpu else 2))
+        ltps, lflops = _lm_throughput(batch=lbatch, seq_len=lseq,
+                                      steps=lsteps, mesh=mesh, dtype=dtype)
+        lvs = _vs_baseline(baselines, f"{platform}:causal_lm_2048_train_v1",
+                           ltps, base_path)
+        lmfu = None
+        if lflops and peak:
+            lmfu = ltps * (lflops / (lbatch * lseq)) / peak
+        lm = {"metric": "causal_lm_768x12 T2048 train tokens/sec/chip",
+              "value": round(ltps, 2), "vs_baseline": round(lvs, 4),
+              "mfu": round(lmfu, 4) if lmfu else None}
+
     attn_speedup = None
     if on_tpu and os.environ.get("BENCH_ATTENTION", "1") != "0":
         attn_speedup = _attention_speedup()
@@ -268,6 +351,7 @@ def main() -> None:
         "flops_per_image": round(flops_per_image) if flops_per_image else None,
         "device_kind": device_kind,
         "secondary": secondary,
+        "lm": lm,
         "flash_attention_speedup":
             round(attn_speedup, 3) if attn_speedup else None,
     }))

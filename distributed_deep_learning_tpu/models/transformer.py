@@ -195,6 +195,61 @@ class TransformerSeq2Seq(nn.Module):
         return Embed.logits(y, emb)
 
 
+class CausalLM(nn.Module):
+    """GPT-style decoder-only LM — the long-context flagship shape.
+
+    ``__call__(tokens)`` returns the final hidden states ``(B, T, d)``;
+    ``loss(params, hidden, targets)`` computes the weight-tied LM loss via
+    :func:`..ops.fused_ce.fused_linear_cross_entropy` (never materialises
+    the ``(B·T, V)`` logit matrix), and ``logits_from(params, hidden)``
+    the explicit projection for eval/tests.  The reference has no autoregressive model
+    at all (its only sequence model consumes 10-step windows,
+    ``LSTM/dataset.py:25``); this is the shape ring attention / Ulysses /
+    the SPMD pipeline and the flash kernels are built to scale.
+    """
+
+    vocab_size: int = 32000
+    num_layers: int = 12
+    d_model: int = 768
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dropout_rate: float = 0.0
+    max_len: int = 8192
+    dtype: jnp.dtype = jnp.float32
+    attention_fn: Optional[AttentionFn] = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        valid = tokens != 0
+        x, _ = Embed(self.vocab_size, self.d_model, max_len=self.max_len,
+                     dtype=self.dtype, name="embed")(tokens)
+        for i in range(self.num_layers):
+            x = TransformerLayer(self.num_heads, self.mlp_dim,
+                                 self.dropout_rate, causal=True,
+                                 dtype=self.dtype,
+                                 attention_fn=self.attention_fn,
+                                 name=f"layer_{i}")(x, self_valid=valid,
+                                                    train=train)
+        return nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
+
+    def _table(self, params):
+        return params["params"]["embed"]["tok"]["embedding"]
+
+    def loss(self, params, hidden, targets):
+        """Mean next-token cross-entropy via the fused head (pad id 0
+        excluded); pass ``tokens[:, :-1]`` hidden vs ``tokens[:, 1:]``."""
+        from distributed_deep_learning_tpu.ops.fused_ce import (
+            fused_linear_cross_entropy)
+
+        return fused_linear_cross_entropy(
+            hidden.astype(jnp.float32),
+            jnp.asarray(self._table(params), jnp.float32), targets)
+
+    def logits_from(self, params, hidden):
+        table = jnp.asarray(self._table(params), jnp.float32)
+        return jnp.einsum("...d,vd->...v", hidden.astype(jnp.float32), table)
+
+
 class BertEncoder(nn.Module):
     """BERT-base-shaped bidirectional encoder with an MLM head
     (BASELINE config[4]: MLM pretrain, pjit 2D mesh + ZeRO-1)."""

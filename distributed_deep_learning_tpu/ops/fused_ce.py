@@ -37,10 +37,26 @@ from jax import lax
 NEG_INF = -1e30
 
 
-def _block_count(V: int, block: int) -> int:
-    if V % block:
-        raise ValueError(f"vocab {V} must be divisible by block {block}")
-    return V // block
+def _padded_blocks(table, block):
+    """Pad the (V, d) table with zero rows to a block multiple and reshape
+    to (nb, block, d); padded rows are masked to −∞ logits downstream, so
+    ANY vocab size works at full block width (a largest-divisor snap would
+    degenerate to block=1 on prime vocabs like GPT-2's 50257)."""
+    V, d = table.shape
+    block = min(block, V)
+    pad = (-V) % block
+    w = table.astype(jnp.float32)
+    if pad:
+        w = jnp.concatenate([w, jnp.zeros((pad, d), jnp.float32)])
+    return w.reshape(-1, block, d), block
+
+
+def _block_logits(h32, wb, i, block, V):
+    """One block's logits with vocab-padding rows masked to −∞."""
+    logits = h32 @ wb.T                                      # (N, block)
+    vocab_pos = i * block + jax.lax.broadcasted_iota(
+        jnp.int32, logits.shape, 1)
+    return jnp.where(vocab_pos < V, logits, NEG_INF)
 
 
 def _fwd(h, table, targets, ignore_id, block):
@@ -51,14 +67,14 @@ def _fwd(h, table, targets, ignore_id, block):
     """
     N, d = h.shape
     V = table.shape[0]
-    nb = _block_count(V, block)
     h32 = h.astype(jnp.float32)
-    w = table.astype(jnp.float32).reshape(nb, block, d)
+    w, block = _padded_blocks(table, block)
+    nb = w.shape[0]
 
     def fold(carry, wb_i):
         m, s, tgt_logit = carry
         wb, i = wb_i
-        logits = h32 @ wb.T                                  # (N, block)
+        logits = _block_logits(h32, wb, i, block, V)
         bmax = jnp.max(logits, axis=-1)
         new_m = jnp.maximum(m, bmax)
         s = s * jnp.exp(m - new_m) + jnp.sum(
@@ -110,15 +126,15 @@ def _vjp_bwd(ignore_id, block, res, g):
     tf = targets.reshape(-1)
     N, d = h2.shape
     V = table.shape[0]
-    nb = _block_count(V, block)
-    w = table.astype(jnp.float32).reshape(nb, block, d)
+    w, block = _padded_blocks(table, block)
+    nb = w.shape[0]
 
-    # pass 1 (recompute): the normalisers
-    _, valid = _fwd(h2, table, tf, ignore_id, block)
-    # recompute logsumexp pieces (shared with _fwd; cheap relative to bwd)
-    def lse(carry, wb):
+    valid = tf != ignore_id
+    # pass 1 (recompute): the logsumexp normalisers
+    def lse(carry, wb_i):
         m, s = carry
-        logits = h2 @ wb.T
+        wb, i = wb_i
+        logits = _block_logits(h2, wb, i, block, V)
         bmax = jnp.max(logits, axis=-1)
         new_m = jnp.maximum(m, bmax)
         s = s * jnp.exp(m - new_m) + jnp.sum(
@@ -126,7 +142,8 @@ def _vjp_bwd(ignore_id, block, res, g):
         return (new_m, s), None
 
     (m, s), _ = lax.scan(lse, (jnp.full((N,), NEG_INF, jnp.float32),
-                               jnp.zeros((N,), jnp.float32)), w)
+                               jnp.zeros((N,), jnp.float32)),
+                         (w, jnp.arange(nb)))
     logz = m + jnp.log(s)
     count = jnp.maximum(jnp.sum(valid), 1)
     scale = (g / count) * valid.astype(jnp.float32)       # (N,)
@@ -134,7 +151,7 @@ def _vjp_bwd(ignore_id, block, res, g):
     # pass 2: dh and dW block by block — (softmax - onehot) folded in
     def bwd_block(dh, wb_i):
         wb, i = wb_i
-        logits = h2 @ wb.T
+        logits = _block_logits(h2, wb, i, block, V)
         p = jnp.exp(logits - logz[:, None])               # softmax block
         local = tf - i * block
         inside = (local >= 0) & (local < block)
@@ -147,8 +164,9 @@ def _vjp_bwd(ignore_id, block, res, g):
 
     dh0 = jnp.zeros_like(h2)
     dh, dw = lax.scan(bwd_block, dh0, (w, jnp.arange(nb)))
+    # drop the vocab-padding rows (their p, hence delta, is exactly 0)
     return (dh.reshape(shape).astype(h.dtype),
-            dw.reshape(V, d).astype(table.dtype), None)
+            dw.reshape(-1, d)[:V].astype(table.dtype), None)
 
 
 fused_linear_cross_entropy.defvjp(_vjp_fwd, _vjp_bwd)

@@ -156,10 +156,11 @@ def test_space_to_depth_stem_exact_parity():
 
 def test_resnet_stem_s2d_model_runs_and_masked_taps_inert():
     """stem_s2d=True is the same function CLASS as the 7x7 stem: output
-    shapes match, and the conv mask keeps the 45 packed-kernel slots that
-    fall outside the original 7x7 window inert — perturbing one of them
-    (the (ua=0, pa=0) row, i.e. the nonexistent a=-1 tap) must not change
-    the output."""
+    shapes match, and the conv mask keeps the packed-kernel slots that
+    fall outside the original 7x7 window inert (15 of the 64 (ua,pa,ub,pb)
+    slots: only a=-1 / b=-1 are out of range, 64 - 7x7 = 15) — perturbing
+    the (ua=0, pa=0) row (the nonexistent a=-1 tap) must not change the
+    output."""
     import jax
     import jax.numpy as jnp
     import numpy as np
