@@ -146,3 +146,18 @@ def synthetic_c4_mlm(n: int = 1024, seq_len: int = 64,
     masked = tokens.copy()
     masked[rng.random(size=tokens.shape) < mask_rate] = mask_id
     return ArrayDataset(masked, tokens)
+
+
+def synthetic_lm(n: int = 1024, seq_len: int = 64, vocab_size: int = 1024,
+                 seed: int = 0) -> ArrayDataset:
+    """Causal-LM twin: rows follow a cyclic +1 token rule from a random
+    start (x[t+1] = x[t] + 1 over [1, vocab)), so next-token accuracy
+    climbs within an epoch — the training-signal analogue of the planted
+    linear signal in :func:`synthetic_mqtt`.  Features = rows[:, :-1],
+    targets = rows[:, 1:]."""
+    rng = np.random.default_rng(seed)
+    start = rng.integers(1, vocab_size, size=(n, 1))
+    ramp = np.arange(seq_len + 1)[None, :]
+    rows = ((start - 1 + ramp) % (vocab_size - 1) + 1).astype(np.int32)
+    return ArrayDataset(np.ascontiguousarray(rows[:, :-1]),
+                        np.ascontiguousarray(rows[:, 1:]))

@@ -74,3 +74,16 @@ def seq2seq_dataset(tokens: np.ndarray, *, src_len: int | None = None,
                          f"{tokens.shape[1]}")
     vocab = vocab_size or int(tokens.max()) + 1
     return TokenArrayDataset(tokens, tokens[:, src_len:].copy(), vocab)
+
+
+def lm_dataset(tokens: np.ndarray,
+               vocab_size: int | None = None) -> TokenArrayDataset:
+    """Next-token prediction rows for the ``gpt`` workload: features are
+    ``tokens[:, :-1]``, targets the one-step shift ``tokens[:, 1:]`` (pad
+    id 0 positions are excluded by ``token_cross_entropy``)."""
+    tokens = np.asarray(tokens, np.int32)
+    if tokens.shape[1] < 2:
+        raise ValueError("lm_dataset needs rows of at least 2 tokens")
+    vocab = vocab_size or int(tokens.max()) + 1
+    return TokenArrayDataset(np.ascontiguousarray(tokens[:, :-1]),
+                             np.ascontiguousarray(tokens[:, 1:]), vocab)

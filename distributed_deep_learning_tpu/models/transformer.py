@@ -215,14 +215,15 @@ class CausalLM(nn.Module):
     mlp_dim: int = 3072
     dropout_rate: float = 0.0
     max_len: int = 8192
+    with_logits: bool = False   # True: __call__ returns (B, T, V) logits
     dtype: jnp.dtype = jnp.float32
     attention_fn: Optional[AttentionFn] = None
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
         valid = tokens != 0
-        x, _ = Embed(self.vocab_size, self.d_model, max_len=self.max_len,
-                     dtype=self.dtype, name="embed")(tokens)
+        x, emb = Embed(self.vocab_size, self.d_model, max_len=self.max_len,
+                       dtype=self.dtype, name="embed")(tokens)
         for i in range(self.num_layers):
             x = TransformerLayer(self.num_heads, self.mlp_dim,
                                  self.dropout_rate, causal=True,
@@ -230,7 +231,11 @@ class CausalLM(nn.Module):
                                  attention_fn=self.attention_fn,
                                  name=f"layer_{i}")(x, self_valid=valid,
                                                     train=train)
-        return nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
+        # the CLI/workload convention wants logits (token_cross_entropy +
+        # argmax metrics); the bench path keeps hidden states and the
+        # fused head (loss()) so (B·T, V) never materialises
+        return Embed.logits(x, emb) if self.with_logits else x
 
     def _table(self, params):
         return params["params"]["embed"]["tok"]["embedding"]

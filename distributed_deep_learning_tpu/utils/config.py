@@ -178,6 +178,7 @@ WORKLOAD_DEFAULTS: dict[str, dict[str, int]] = {
     "transformer": {"nlayers": 6, "size": 512},
     "bert": {"nlayers": 12, "size": 768},
     "moe": {"nlayers": 4, "size": 256},
+    "gpt": {"nlayers": 12, "size": 768},
 }
 
 

@@ -16,7 +16,7 @@ def get_spec(name: str):
         from distributed_deep_learning_tpu.workloads.cnn import SPEC
     elif name == "lstm":
         from distributed_deep_learning_tpu.workloads.lstm import SPEC
-    elif name in ("resnet", "transformer", "bert", "moe"):
+    elif name in ("resnet", "transformer", "bert", "moe", "gpt"):
         from distributed_deep_learning_tpu.workloads.northstar import SPECS
         return SPECS[name]
     else:
@@ -26,4 +26,4 @@ def get_spec(name: str):
 
 
 WORKLOADS = ("mlp", "cnn", "lstm", "mnist", "resnet", "transformer",
-             "bert", "moe")
+             "bert", "moe", "gpt")

@@ -358,8 +358,84 @@ MOE_SPEC = WorkloadSpec(
     tp_rules=_moe_rules,
 )
 
+# --- gpt (decoder-only causal LM) ------------------------------------------
+
+def _gpt_dataset(config: Config, seq_len: int = 64, vocab: int = 1024):
+    if config.data_dir:
+        from distributed_deep_learning_tpu.data.tokens import (lm_dataset,
+                                                               load_tokens)
+
+        tokens = load_tokens(config.data_dir)
+        if tokens is not None:
+            return lm_dataset(tokens)
+    from distributed_deep_learning_tpu.data.datasets import synthetic_lm
+
+    # vocab matches _vocab()'s synthetic default (1024)
+    return synthetic_lm(seq_len=seq_len, vocab_size=vocab, seed=config.seed)
+
+
+def _gpt_model(config: Config, dataset):
+    from distributed_deep_learning_tpu.models.transformer import CausalLM
+
+    d = config.size
+    return CausalLM(vocab_size=_vocab(dataset),
+                    num_layers=config.num_layers, d_model=d,
+                    num_heads=max(2, d // 64), mlp_dim=4 * d,
+                    dropout_rate=config.dropout, with_logits=True,
+                    max_len=max(dataset.features.shape[1], 8),
+                    dtype=config_dtype(config),
+                    attention_fn=_attention_fn(config))
+
+
+def _gpt_layers(config: Config, dataset):
+    """``-m model``: embed / causal blocks / full-sequence head."""
+    from distributed_deep_learning_tpu.models.pipelined_lm import (LMEmbed,
+                                                                   LMHead)
+    from distributed_deep_learning_tpu.models.transformer import (
+        TransformerLayer)
+
+    d = config.size
+    dtype = config_dtype(config)
+    max_len = max(dataset.features.shape[1], 8)
+    return [LMEmbed(_vocab(dataset), d, max_len=max_len, dtype=dtype)] + [
+        TransformerLayer(max(2, d // 64), 4 * d, dropout_rate=0.0,
+                         causal=True, dtype=dtype)
+        for _ in range(config.num_layers)
+    ] + [LMHead(_vocab(dataset), dtype=dtype)]  # predict at every position
+
+
+def _gpt_pipelined(config: Config, dataset, mesh):
+    from distributed_deep_learning_tpu.models.pipelined_lm import PipelinedLM
+
+    d = config.size
+    return PipelinedLM(vocab_size=_vocab(dataset),
+                       num_layers=config.num_layers, d_model=d,
+                       num_heads=max(2, d // 64), mlp_dim=4 * d, mesh=mesh,
+                       causal=True,  # head_take None: every position
+                       microbatch_size=config.microbatch,
+                       max_len=max(dataset.features.shape[1], 4096),
+                       dtype=config_dtype(config),
+                       attention_fn=_attention_fn(config),
+                       dropout_rate=config.dropout)
+
+
+GPT_SPEC = WorkloadSpec(
+    name="gpt",
+    build_dataset=_gpt_dataset,
+    build_model=_gpt_model,
+    build_layers=_gpt_layers,
+    partitioner=balanced_partition,
+    build_loss=lambda c: token_cross_entropy,
+    build_optimizer=lambda c, steps: optax.adamw(
+        resolve_lr(c, steps, c.learning_rate)),
+    example_input=lambda c, ds: jnp.zeros((1, ds.features.shape[1]),
+                                          jnp.int32),
+    tp_rules=lambda c: transformer_tp_rules(),
+    build_pipelined=_gpt_pipelined,
+)
+
 SPECS = {"resnet": RESNET_SPEC, "transformer": TRANSFORMER_SPEC,
-         "bert": BERT_SPEC, "moe": MOE_SPEC}
+         "bert": BERT_SPEC, "moe": MOE_SPEC, "gpt": GPT_SPEC}
 
 
 def main(argv=None, workload: str = "resnet"):

@@ -119,3 +119,34 @@ def test_tensor_parallel_rejected_without_rules():
     with pytest.raises(ValueError, match="tensor-parallel"):
         _run("resnet", ["-e", "1", "-b", "32", "-m", "data",
                         "--mesh", "data=2,model=4"])
+
+
+def test_gpt_trains_and_learns():
+    """Decoder-only LM on the +1-rule synthetic corpus: next-token
+    accuracy must land well above the 0.1% chance floor within two epochs
+    and improve epoch over epoch."""
+    _, history = _run("gpt", ["-l", "2", "-s", "64", "-e", "2", "-b", "32",
+                              "-m", "data"])
+    _ok(history)
+    trains = [h for h in history if h.phase == "train"]
+    accs = [h.accuracy for h in trains]
+    assert accs[-1] > 3.0 and accs[-1] > accs[0], accs
+
+
+def test_gpt_model_mode_staged():
+    _, history = _run("gpt", ["-l", "2", "-s", "32", "-e", "1", "-b", "16",
+                              "-m", "model", "--nstages", "2"], limit=128)
+    _ok(history)
+
+
+def test_gpt_pipeline_mode():
+    _, history = _run("gpt", ["-l", "2", "-s", "32", "-e", "1", "-b", "16",
+                              "-m", "pipeline", "--nstages", "2",
+                              "--mesh", "stage=2"], limit=128)
+    _ok(history)
+
+
+def test_gpt_zero1():
+    _, history = _run("gpt", ["-l", "1", "-s", "32", "-e", "1", "-b", "16",
+                              "--zero", "1"], limit=128)
+    _ok(history)
