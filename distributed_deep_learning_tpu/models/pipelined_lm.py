@@ -91,14 +91,19 @@ class PipelinedLM:
                  head_take: Optional[tuple[int, int]] = None,
                  microbatch_size: Optional[int] = None,
                  max_len: int = 4096, dtype: jnp.dtype = jnp.float32,
-                 attention_fn=None, dropout_rate: float = 0.0):
+                 attention_fn=None, dropout_rate: float = 0.0,
+                 n_chunks: int = 1):
         self.embed = LMEmbed(vocab_size, d_model, max_len, dtype)
         self.trunk = PipelinedTrunk(num_layers, mesh, num_heads=num_heads,
                                     mlp_dim=mlp_dim, causal=causal,
                                     dtype=dtype,
                                     microbatch_size=microbatch_size,
                                     attention_fn=attention_fn,
-                                    dropout_rate=dropout_rate)
+                                    dropout_rate=dropout_rate,
+                                    n_chunks=n_chunks)
+        if n_chunks > 1:
+            # (V, S, ...) stacks: chunk dim replicated, stage dim sharded
+            self.shard_rules = ((r"^trunk/.*", P(None, "stage")),)
         self.head = LMHead(vocab_size, head_take, dtype)
 
     def init(self, rng: jax.Array, tokens: jnp.ndarray) -> dict[str, Any]:

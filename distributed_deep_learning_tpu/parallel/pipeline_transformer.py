@@ -61,29 +61,47 @@ class TrunkStage(nn.Module):
 
 
 class PipelinedTrunk:
-    """A transformer trunk split over the mesh's ``stage`` axis."""
+    """A transformer trunk split over the mesh's ``stage`` axis.
+
+    ``n_chunks > 1`` (interleaved pipelining) gives each device ``V``
+    non-contiguous model chunks: virtual stage ``v·S + s`` lives on device
+    ``s``, params stack as ``(V, S, ...)``, and the interleaved-1F1B
+    schedule (:func:`.spmd_pipeline.spmd_pipeline_interleaved`) fills the
+    pipeline bubble with the extra chunks.
+    """
 
     def __init__(self, num_layers: int, mesh: Mesh, *, num_heads: int = 8,
                  mlp_dim: int = 2048, causal: bool = False,
                  dtype: jnp.dtype = jnp.float32,
                  microbatch_size: Optional[int] = None,
-                 attention_fn=None, dropout_rate: float = 0.0):
+                 attention_fn=None, dropout_rate: float = 0.0,
+                 n_chunks: int = 1):
         self.mesh = mesh
         self.n_stages = mesh.shape["stage"]
-        if num_layers % self.n_stages:
+        if n_chunks < 1:
+            raise ValueError(f"n_chunks must be >= 1, got {n_chunks}")
+        self.n_chunks = n_chunks
+        n_virtual = self.n_stages * n_chunks
+        if num_layers % n_virtual:
             raise ValueError(f"{num_layers} layers not divisible into "
-                             f"{self.n_stages} stages")
+                             f"{self.n_stages} stages x {n_chunks} chunks")
         self.microbatch_size = microbatch_size
-        self.stage = TrunkStage(num_layers // self.n_stages, num_heads,
+        self.stage = TrunkStage(num_layers // n_virtual, num_heads,
                                 mlp_dim, causal, dtype, attention_fn,
                                 dropout_rate)
 
     def init(self, rng: jax.Array, example: jnp.ndarray) -> Any:
-        """Stacked per-stage params (leading dim = stage; shard it)."""
+        """Stacked per-stage params: ``(S, ...)`` leaves, or ``(V, S, ...)``
+        when interleaving (virtual stage ``v·S + s`` at index [v, s])."""
         params = [
             self.stage.init(jax.random.fold_in(rng, i), example)["params"]
-            for i in range(self.n_stages)]
-        return stack_stage_params(params)
+            for i in range(self.n_stages * self.n_chunks)]
+        stacked = stack_stage_params(params)
+        if self.n_chunks == 1:
+            return stacked
+        return jax.tree.map(
+            lambda l: l.reshape(self.n_chunks, self.n_stages, *l.shape[1:]),
+            stacked)
 
     def stage_fn(self):
         """One stage's pure ``(params, x) -> y`` — the unit both pipeline
@@ -99,20 +117,36 @@ class PipelinedTrunk:
     def apply(self, stacked_params: Any, x: jnp.ndarray,
               rng: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         """(B, T, d) → (B, T, d) through all stages, pipelined; pass
-        ``rng`` to activate dropout."""
-        if rng is not None:
-            return spmd_pipeline(
-                self.stage_fn_train(), stacked_params, x, mesh=self.mesh,
-                microbatch_size=self.microbatch_size, rng=rng)
-        return spmd_pipeline(
-            self.stage_fn(), stacked_params, x, mesh=self.mesh,
-            microbatch_size=self.microbatch_size)
+        ``rng`` to activate dropout.  With ``n_chunks > 1`` the forward
+        laps the S-stage GPipe pipeline V times (chunk ``v`` of every
+        device = lap ``v``) — correct for eval and for the
+        scan-transpose backward; the train step swaps in the interleaved
+        1F1B schedule instead."""
+        laps = ([jax.tree.map(lambda l, v=v: l[v], stacked_params)
+                 for v in range(self.n_chunks)]
+                if self.n_chunks > 1 else [stacked_params])
+        for v, lap in enumerate(laps):
+            if rng is not None:
+                x = spmd_pipeline(
+                    self.stage_fn_train(), lap, x, mesh=self.mesh,
+                    microbatch_size=self.microbatch_size,
+                    rng=jax.random.fold_in(rng, v))
+            else:
+                x = spmd_pipeline(
+                    self.stage_fn(), lap, x, mesh=self.mesh,
+                    microbatch_size=self.microbatch_size)
+        return x
 
     def apply_sequential(self, stacked_params: Any, x: jnp.ndarray
                          ) -> jnp.ndarray:
         """Reference semantics: the same stages applied one after another
         without the pipeline (for equivalence tests; deterministic)."""
-        for s in range(self.n_stages):
-            p = jax.tree.map(lambda l, s=s: l[s], stacked_params)
+        for i in range(self.n_stages * self.n_chunks):
+            if self.n_chunks == 1:
+                p = jax.tree.map(lambda l, i=i: l[i], stacked_params)
+            else:
+                p = jax.tree.map(
+                    lambda l, i=i: l[i // self.n_stages, i % self.n_stages],
+                    stacked_params)
             x = self.stage.apply({"params": p}, x)
         return x

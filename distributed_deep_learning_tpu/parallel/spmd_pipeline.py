@@ -49,6 +49,35 @@ def stack_stage_params(params_list: list[Any]) -> Any:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
 
 
+def _split_microbatches(x, targets, mesh, microbatch_size, batch_axes,
+                        n_stages):
+    """Shared pipeline prologue: derive (M, mb), validate divisibility
+    against the data-parallel degree, reshape x/targets to (M, mb, ...).
+
+    Returns ``(xs, ts, M, mb, dp_axes, dp)``; ``targets``/``ts`` may be
+    None (forward-only pipelines)."""
+    B = x.shape[0]
+    if microbatch_size is None:
+        M = max(m for m in range(1, n_stages + 1) if B % m == 0)
+        mb = B // M
+    else:
+        mb = microbatch_size
+        if B % mb:
+            raise ValueError(f"batch {B} not divisible by microbatch {mb}")
+        M = B // mb
+    dp_axes = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+    dp = 1
+    for a in dp_axes:
+        dp *= mesh.shape[a]
+    if mb % dp:
+        raise ValueError(f"microbatch size {mb} not divisible by "
+                         f"data-parallel size {dp}")
+    xs = x.reshape(M, mb, *x.shape[1:])
+    ts = None if targets is None else jax.tree.map(
+        lambda a: a.reshape(M, mb, *a.shape[1:]), targets)
+    return xs, ts, M, mb, dp_axes, dp
+
+
 def spmd_pipeline(stage_fn: StageFn, stacked_params: Any, x: jnp.ndarray, *,
                   mesh: Mesh, microbatch_size: int | None = None,
                   axis: str = "stage", batch_axes: tuple[str, ...] = ("data", "fsdp"),
@@ -73,24 +102,8 @@ def spmd_pipeline(stage_fn: StageFn, stacked_params: Any, x: jnp.ndarray, *,
     """
     S = mesh.shape[axis]
     B = x.shape[0]
-    if microbatch_size is None:
-        # divisor-safe default: the largest microbatch count <= S that
-        # divides B (M == S when possible, M == 1 in the worst case)
-        M = max(m for m in range(1, S + 1) if B % m == 0)
-        mb = B // M
-    else:
-        mb = microbatch_size
-        if B % mb:
-            raise ValueError(f"batch {B} not divisible by microbatch size {mb}")
-        M = B // mb
-    dp = mesh.shape.get(batch_axes[0], 1) if len(batch_axes) else 1
-    for ax in batch_axes[1:]:
-        dp *= mesh.shape.get(ax, 1)
-    if mb % dp:
-        raise ValueError(
-            f"microbatch size {mb} not divisible by data-parallel size {dp} "
-            f"(mesh axes {batch_axes} = {[mesh.shape.get(a, 1) for a in batch_axes]})")
-    xs = x.reshape(M, mb, *x.shape[1:])
+    xs, _, M, mb, _, _ = _split_microbatches(x, None, mesh,
+                                             microbatch_size, batch_axes, S)
 
     batch_spec = P(None, batch_axes)  # (M, mb, ...): shard the mb dim
     param_spec = P(axis)
@@ -192,23 +205,8 @@ def spmd_pipeline_1f1b(stage_fn: StageFn, head_loss_fn, stacked_params: Any,
     """
     S = mesh.shape[axis]
     B = x.shape[0]
-    if microbatch_size is None:
-        M = max(m for m in range(1, S + 1) if B % m == 0)
-        mb = B // M
-    else:
-        mb = microbatch_size
-        if B % mb:
-            raise ValueError(f"batch {B} not divisible by microbatch {mb}")
-        M = B // mb
-    dp_axes = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
-    dp = 1
-    for a in dp_axes:
-        dp *= mesh.shape[a]
-    if mb % dp:
-        raise ValueError(f"microbatch size {mb} not divisible by "
-                         f"data-parallel size {dp}")
-    xs = x.reshape(M, mb, *x.shape[1:])
-    ts = jax.tree.map(lambda a: a.reshape(M, mb, *a.shape[1:]), targets)
+    xs, ts, M, mb, dp_axes, dp = _split_microbatches(
+        x, targets, mesh, microbatch_size, batch_axes, S)
 
     R = 2 * S - 1           # residual ring slots (peak in-flight + 1)
     T = M + 2 * S - 2       # total schedule ticks
@@ -315,6 +313,332 @@ def spmd_pipeline_1f1b(stage_fn: StageFn, head_loss_fn, stacked_params: Any,
         loss = loss * scale                          # Σ shard/mb sums → mean
         hg = jax.tree.map(lambda a: a * scale, hg)
         tg = jax.tree.map(lambda a: (a * scale)[None], tg)  # restack stage dim
+        return loss, tg, hg, dx, aux
+
+    loss, tg, hg, dx, aux = run(stacked_params, head_params, xs, ts)
+    dx = dx.reshape(B, *dx.shape[2:])
+    if has_aux:
+        return loss, tg, hg, dx, aux
+    return loss, tg, hg, dx
+
+
+def interleaved_1f1b_schedule(n_microbatches: int, n_stages: int,
+                              n_chunks: int, max_in_flight: int = 2):
+    """Greedy list schedule for INTERLEAVED 1F1B: ``V`` model chunks per
+    device, virtual stage ``v·S + s`` living on device ``s`` (consecutive
+    virtual stages on consecutive devices, so activations always hop to
+    the ring neighbour).  Cuts the pipeline bubble ~``V``× vs plain 1F1B:
+    during fill/drain a device works on its other chunks instead of
+    idling (Megatron-LM's interleaved schedule, built here by greedy list
+    scheduling with explicit dependency / flow-control / capacity
+    constraints rather than closed-form tick maps).
+
+    Returns ``(ops, n_ticks)`` where ops is a list of
+    ``(tick, stage, 'F'|'B', chunk, microbatch)``.  Constraints enforced
+    (asserted by ``tests/test_spmd_pipeline_interleaved.py``):
+
+    * deps — F(v,m) needs F(v−1,m) at an earlier tick; B(v,m) needs
+      B(v+1,m) earlier and F(v,m) at the same tick or earlier (the last
+      virtual stage seeds its backward in the same tick, 1F1B style).
+    * flow control — at most 2 activations (cotangents) in flight per
+      receiving virtual stage: the executor double-buffers by microbatch
+      parity, so a sender schedules only when < 2 are unconsumed.
+    * capacity — each device runs ≤ 1 F and ≤ 1 B per tick.
+
+    Priorities: backward first (drains residuals, keeps memory O(S·V)),
+    then the deepest ready forward (depth-first — pushes early
+    microbatches to the last stage so its 1F1B steady state starts ASAP).
+    """
+    M, S, V = n_microbatches, n_stages, n_chunks
+    L = V * S
+    f_done: dict[tuple[int, int], int] = {}   # (v, m) -> tick
+    b_done: dict[tuple[int, int], int] = {}
+    f_count = [0] * L                         # Fs completed per v
+    b_count = [0] * L
+    ops = []
+    t = 0
+    while len(b_done) < L * M:
+        progressed = False
+        for s in range(S):
+            hosted = [v for v in range(s, L, S)]
+            # ---- backward: smallest microbatch first ----
+            b_ready = []
+            for v in hosted:
+                m = b_count[v]
+                if m >= M:
+                    continue
+                if (v, m) not in f_done or f_done[(v, m)] > t:
+                    continue
+                if v < L - 1 and b_done.get((v + 1, m), t) >= t:
+                    continue
+                # sender-side flow control for the cotangent to v-1
+                if v > 0 and b_count[v] - b_count[v - 1] >= max_in_flight:
+                    continue
+                b_ready.append((m, v))
+            if b_ready:
+                m, v = min(b_ready)
+                b_done[(v, m)] = t
+                b_count[v] += 1
+                ops.append((t, s, "B", v // S, m))
+                progressed = True
+            # ---- forward: deepest virtual stage first ----
+            f_ready = []
+            for v in hosted:
+                m = f_count[v]
+                if m >= M:
+                    continue
+                if v > 0 and f_done.get((v - 1, m), t) >= t:
+                    continue
+                # sender-side flow control for the activation to v+1
+                if v < L - 1 and f_count[v] - f_count[v + 1] >= max_in_flight:
+                    continue
+                f_ready.append((-v, m))
+            if f_ready:
+                negv, m = min(f_ready)
+                v = -negv
+                f_done[(v, m)] = t
+                f_count[v] += 1
+                ops.append((t, s, "F", v // S, m))
+                progressed = True
+                # the last virtual stage may backward the same microbatch
+                # in the same tick (seeded by the in-tick head loss)
+                if v == L - 1 and b_count[v] == m and \
+                        (s, t) not in {(o[1], o[0]) for o in ops
+                                       if o[2] == "B"}:
+                    b_done[(v, m)] = t
+                    b_count[v] += 1
+                    ops.append((t, s, "B", v // S, m))
+        if not progressed and len(b_done) < L * M:
+            raise RuntimeError(
+                f"interleaved schedule deadlocked at tick {t} "
+                f"(M={M}, S={S}, V={V})")
+        t += 1
+    return ops, t
+
+
+def _schedule_tables(M: int, S: int, V: int):
+    """Numpy lookup tables driving the interleaved executor: per-(tick,
+    device) F/B ops, arrival routing (which chunk/microbatch the incoming
+    ppermute carry belongs to), dx emission ticks, and the residual-ring
+    depth.  All static given (M, S, V)."""
+    import numpy as np
+
+    ops, T = interleaved_1f1b_schedule(M, S, V)
+    L = V * S
+    neg = lambda: np.full((T, S), -1, np.int32)  # noqa: E731
+    f_chunk, f_mb, b_chunk, b_mb = neg(), neg(), neg(), neg()
+    for t, s, kind, c, m in ops:
+        if kind == "F":
+            f_chunk[t, s], f_mb[t, s] = c, m
+        else:
+            b_chunk[t, s], b_mb[t, s] = c, m
+    fin_chunk, fin_mb, bin_chunk, bin_mb = neg(), neg(), neg(), neg()
+    for t in range(1, T):
+        for s in range(S):
+            sp = (s - 1) % S
+            c, m = f_chunk[t - 1, sp], f_mb[t - 1, sp]
+            if c >= 0:
+                v = c * S + sp
+                if v < L - 1:           # last virtual stage feeds the head
+                    fin_chunk[t, s], fin_mb[t, s] = (v + 1) // S, m
+            sn = (s + 1) % S
+            c, m = b_chunk[t - 1, sn], b_mb[t - 1, sn]
+            if c >= 0:
+                v = c * S + sn
+                if v > 0:               # virtual stage 0 emits dx instead
+                    bin_chunk[t, s], bin_mb[t, s] = (v - 1) // S, m
+    dx_tick = np.zeros((M,), np.int32)
+    for t, s, kind, c, m in ops:
+        if kind == "B" and s == 0 and c == 0:
+            dx_tick[m] = t
+    # residual-ring depth: max F-completed-but-not-B per virtual stage.
+    # Order F before B within a tick — the executor writes the F residual
+    # BEFORE the B read, so both are momentarily live; a plain sorted()
+    # would order "B" < "F" lexicographically and undercount by one,
+    # letting the F write clobber the very slot B reads (silently wrong
+    # gradients whenever F(v, m) and B(v, m-R) share a device-tick).
+    depth, live = 1, {}
+    for t, s, kind, c, m in sorted(ops, key=lambda o: (o[0],
+                                                       o[2] != "F")):
+        v = c * S + s
+        if kind == "F":
+            live[v] = live.get(v, 0) + 1
+            depth = max(depth, live[v])
+        else:
+            live[v] = live.get(v, 0) - 1
+    return dict(f_chunk=f_chunk, f_mb=f_mb, b_chunk=b_chunk, b_mb=b_mb,
+                fin_chunk=fin_chunk, fin_mb=fin_mb, bin_chunk=bin_chunk,
+                bin_mb=bin_mb, dx_tick=dx_tick, n_ticks=T, resid_depth=depth)
+
+
+def spmd_pipeline_interleaved(stage_fn: StageFn, head_loss_fn,
+                              stacked_params: Any, head_params: Any,
+                              x: jnp.ndarray, targets: Any, *,
+                              mesh: Mesh, microbatch_size: int | None = None,
+                              axis: str = "stage",
+                              batch_axes: tuple[str, ...] = ("data", "fsdp"),
+                              has_aux: bool = False):
+    """Interleaved-1F1B pipelined TRAIN pass: ``V`` chunks per device.
+
+    Same contract as :func:`spmd_pipeline_1f1b` except ``stacked_params``
+    leaves lead with ``(V, S, ...)`` — chunk ``v`` of device ``s`` is
+    virtual stage ``v·S + s``, so consecutive virtual stages sit on ring
+    neighbours and the SAME two ppermutes serve every hop, including chunk
+    wraparound (device S−1 chunk c → device 0 chunk c+1).  The greedy
+    :func:`interleaved_1f1b_schedule` drives a masked `lax.scan`: each
+    tick every device runs ≤1 F and ≤1 B (of possibly different chunks),
+    parks arrivals in per-chunk double buffers (microbatch-parity
+    indexed), and stores stage inputs in a (V, R) residual ring for the
+    rematerialised block backward.
+
+    Returns ``(loss, trunk_grads, head_grads, dx[, aux])`` with
+    ``trunk_grads`` in the (V, S, ...) stacked layout.
+    """
+    S = mesh.shape[axis]
+    V = jax.tree.leaves(stacked_params)[0].shape[0]
+    B = x.shape[0]
+    xs, ts, M, mb, dp_axes, dp = _split_microbatches(
+        x, targets, mesh, microbatch_size, batch_axes, S)
+
+    tbl = _schedule_tables(M, S, V)
+    T, R = tbl["n_ticks"], tbl["resid_depth"]
+    jt = {k: jnp.asarray(v) for k, v in tbl.items()
+          if k not in ("n_ticks", "resid_depth")}
+    scale = 1.0 / (M * dp)
+
+    batch_spec = P(None, batch_axes)
+    param_spec = P(None, axis)
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(param_spec, P(), batch_spec, batch_spec),
+             out_specs=(P(), param_spec, P(), batch_spec, P()),
+             check_vma=False)
+    def run(params, head_params, xs, ts):
+        params = jax.tree.map(lambda p: jnp.squeeze(p, 1), params)  # (V,...)
+        s = lax.axis_index(axis)
+        fperm = [(i, (i + 1) % S) for i in range(S)]
+        bperm = [(i, (i - 1) % S) for i in range(S)]
+        zeros_g = lambda tree: jax.tree.map(  # noqa: E731
+            lambda a: jnp.zeros(a.shape, jnp.float32), tree)
+
+        def masked_add(acc, upd, flag):
+            return jax.tree.map(
+                lambda a, u: a + jnp.where(flag, u.astype(a.dtype), 0), acc,
+                upd)
+
+        def pick_chunk(tree, c):
+            return jax.tree.map(
+                lambda p: lax.dynamic_index_in_dim(p, c, keepdims=False),
+                tree)
+
+        def tick(carry, t):
+            fwd_in, bwd_in, fbuf, bbuf, resid, tg, hg, loss, aux = carry
+            fc = jt["f_chunk"][t, s]
+            fm = jt["f_mb"][t, s]
+            bc = jt["b_chunk"][t, s]
+            bm = jt["b_mb"][t, s]
+            do_f, do_b = fc >= 0, bc >= 0
+            # ---- arrivals: park the previous tick's ppermute carries ----
+            finc = jt["fin_chunk"][t, s]
+            finm = jt["fin_mb"][t, s]
+            ci = jnp.clip(finc, 0, V - 1)
+            pi = jnp.clip(finm, 0, M - 1) % 2
+            fbuf = fbuf.at[ci, pi].set(
+                jnp.where(finc >= 0, fwd_in, fbuf[ci, pi]))
+            binc = jt["bin_chunk"][t, s]
+            binm = jt["bin_mb"][t, s]
+            ci = jnp.clip(binc, 0, V - 1)
+            pi = jnp.clip(binm, 0, M - 1) % 2
+            bbuf = bbuf.at[ci, pi].set(
+                jnp.where(binc >= 0, bwd_in, bbuf[ci, pi]))
+            # ---- forward ----
+            fcl = jnp.clip(fc, 0, V - 1)
+            fmc = jnp.clip(fm, 0, M - 1)
+            x0 = lax.dynamic_index_in_dim(xs, fmc, keepdims=False)
+            f_in = jnp.where(jnp.logical_and(s == 0, fc == 0), x0,
+                             fbuf[fcl, fmc % 2])
+            out = stage_fn(pick_chunk(params, fcl), f_in)
+            old = resid[fcl, fmc % R]
+            resid = resid.at[fcl, fmc % R].set(jnp.where(do_f, f_in, old))
+            # ---- backward ----
+            bcl = jnp.clip(bc, 0, V - 1)
+            bmc = jnp.clip(bm, 0, M - 1)
+            pb = pick_chunk(params, bcl)
+            rin = resid[bcl, bmc % R]
+            y2, stage_vjp = jax.vjp(lambda p, a: stage_fn(p, a), pb, rin)
+            tgt = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, bmc, keepdims=False),
+                ts)
+            if has_aux:
+                lval, head_vjp, aux_mb = jax.vjp(
+                    lambda hp, y: head_loss_fn(hp, y, tgt), head_params, y2,
+                    has_aux=True)
+            else:
+                lval, head_vjp = jax.vjp(
+                    lambda hp, y: head_loss_fn(hp, y, tgt), head_params, y2)
+                aux_mb = {}
+            dhp, dy = head_vjp(jnp.ones((), lval.dtype))
+            is_lastv = jnp.logical_and(s == S - 1, bc == V - 1)
+            seed = jnp.where(is_lastv, dy.astype(y2.dtype),
+                             bbuf[bcl, bmc % 2])
+            dparams, dinp = stage_vjp(seed)
+            tg = jax.tree.map(
+                lambda acc, u: lax.dynamic_update_index_in_dim(
+                    acc,
+                    lax.dynamic_index_in_dim(acc, bcl, keepdims=False)
+                    + jnp.where(do_b, u.astype(acc.dtype), 0),
+                    bcl, axis=0),
+                tg, dparams)
+            hit = jnp.logical_and(do_b, is_lastv)
+            hg = masked_add(hg, dhp, hit)
+            loss = loss + jnp.where(hit, lval.astype(jnp.float32), 0.0)
+            aux = masked_add(aux, aux_mb, hit)
+            # ---- rotate; emit virtual-stage-0 input cotangents ----
+            fwd_next = lax.ppermute(out, axis, fperm)
+            bwd_next = lax.ppermute(dinp, axis, bperm)
+            dx_emit = jnp.where(
+                jnp.logical_and(jnp.logical_and(s == 0, bc == 0), do_b),
+                dinp, 0)
+            return (fwd_next, bwd_next, fbuf, bbuf, resid, tg, hg, loss,
+                    aux), dx_emit
+
+        z = jnp.zeros_like(xs[0])
+        if has_aux:
+            y_s = jax.eval_shape(stage_fn,
+                                 jax.tree.map(lambda p: p[0], params),
+                                 xs[0])
+            aux_shape = jax.eval_shape(
+                head_loss_fn, head_params, y_s,
+                jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape[1:],
+                                                            a.dtype), ts))[1]
+            aux0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
+                                aux_shape)
+        else:
+            aux0 = {}
+        carry0 = (z, z,
+                  jnp.zeros((V, 2) + xs.shape[1:], xs.dtype),
+                  jnp.zeros((V, 2) + xs.shape[1:], xs.dtype),
+                  jnp.zeros((V, R) + xs.shape[1:], xs.dtype),
+                  zeros_g(params), zeros_g(head_params),
+                  jnp.zeros((), jnp.float32), aux0)
+        (_, _, _, _, _, tg, hg, loss, aux), dxs = lax.scan(
+            tick, carry0, jnp.arange(T))
+
+        dxs = jnp.take(dxs, jt["dx_tick"], axis=0)     # (M, mb, ...)
+        dxs = jnp.where(s == 0, dxs, jnp.zeros_like(dxs))
+        dx = lax.psum(dxs, axis) * scale
+        loss = lax.psum(loss, axis)
+        hg = jax.tree.map(lambda a: lax.psum(a, axis), hg)
+        if dp_axes:
+            tg = jax.tree.map(lambda a: lax.psum(a, dp_axes), tg)
+            hg = jax.tree.map(lambda a: lax.psum(a, dp_axes), hg)
+            loss = lax.psum(loss, dp_axes)
+        aux = jax.tree.map(lambda a: lax.psum(a, axis), aux)
+        if dp_axes:
+            aux = jax.tree.map(lambda a: lax.psum(a, dp_axes), aux)
+        loss = loss * scale
+        hg = jax.tree.map(lambda a: a * scale, hg)
+        tg = jax.tree.map(lambda a: (a * scale)[:, None], tg)  # (V, 1, ...)
         return loss, tg, hg, dx, aux
 
     loss, tg, hg, dx, aux = run(stacked_params, head_params, xs, ts)

@@ -146,7 +146,8 @@ class Config:
     image_size: int = 224               # decode size for --data-dir images
     stem_s2d: bool = False              # space-to-depth ResNet stem (TPU opt)
     attention: str = "auto"             # auto|dense|flash (transformer family)
-    pipeline_schedule: str = "gpipe"    # gpipe | 1f1b (SPMD pipeline mode)
+    pipeline_schedule: str = "gpipe"    # gpipe | 1f1b | interleaved
+    virtual_stages: int = 2             # chunks/device (interleaved)
     lr_schedule: str = "none"           # none|cosine|rsqrt|step (north stars)
     warmup_steps: int | None = None     # cosine/rsqrt warmup; None = 5% auto
     clip_norm: float | None = None      # global-norm gradient clipping
@@ -279,12 +280,18 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
     p.add_argument("--metrics-file", type=str, default=None,
                    help="append one JSON object per phase/metric event "
                         "(structured sibling of the reference log stream)")
-    p.add_argument("--pipeline-schedule", choices=["gpipe", "1f1b"],
+    p.add_argument("--pipeline-schedule",
+                   choices=["gpipe", "1f1b", "interleaved"],
                    default="gpipe",
-                   help="SPMD pipeline schedule (-m pipeline, "
-                        "transformer/bert): gpipe = fill-drain with scan-"
-                        "transpose backward; 1f1b = interleaved one-forward-"
-                        "one-backward with O(stages) activation residency")
+                   help="SPMD pipeline schedule (-m pipeline, transformer/"
+                        "bert/gpt): gpipe = fill-drain with scan-transpose "
+                        "backward; 1f1b = one-forward-one-backward with "
+                        "O(stages) activation residency; interleaved = "
+                        "1f1b with --virtual-stages model chunks per "
+                        "device (Megatron-style, ~V x smaller bubble)")
+    p.add_argument("--virtual-stages", type=int, default=2,
+                   help="model chunks per device for --pipeline-schedule "
+                        "interleaved (layers must divide nstages x this)")
     p.add_argument("--elastic", action="store_true",
                    help="restart from the last checkpoint on worker failure "
                         "or runtime error (requires --checkpoint-dir)")
@@ -342,6 +349,7 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         stem_s2d=args.stem_s2d,
         attention=args.attention,
         pipeline_schedule=args.pipeline_schedule,
+        virtual_stages=args.virtual_stages,
         lr_schedule=args.lr_schedule,
         warmup_steps=args.warmup_steps,
         clip_norm=args.clip_norm,

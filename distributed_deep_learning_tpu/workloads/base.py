@@ -307,9 +307,12 @@ def _fit_elastic(config: Config, logger, make_state, train_step, eval_step,
         ckpt.close()
 
 
-def _make_1f1b_train_step(mesh, model, loss_fn, state_spec, microbatch):
+def _make_1f1b_train_step(mesh, model, loss_fn, state_spec, microbatch,
+                          interleaved: bool = False):
     """Train step for a :class:`..models.pipelined_lm.PipelinedLM` under the
-    1F1B schedule (:func:`..parallel.spmd_pipeline.spmd_pipeline_1f1b`):
+    1F1B schedule (:func:`..parallel.spmd_pipeline.spmd_pipeline_1f1b`) or
+    its interleaved variant (``--virtual-stages`` chunks per device,
+    :func:`..parallel.spmd_pipeline.spmd_pipeline_interleaved`):
     embed runs outside (its backward fed by the pipeline's dx), the LM head
     + loss run on the last stage inside the pipeline (the cotangent seed
     must exist the moment a microbatch leaves the last stage)."""
@@ -317,7 +320,7 @@ def _make_1f1b_train_step(mesh, model, loss_fn, state_spec, microbatch):
 
     from distributed_deep_learning_tpu.data.loader import BATCH_AXES
     from distributed_deep_learning_tpu.parallel.spmd_pipeline import (
-        spmd_pipeline_1f1b)
+        spmd_pipeline_1f1b, spmd_pipeline_interleaved)
     from distributed_deep_learning_tpu.train.step import _state_sharding
 
     state_sh = _state_sharding(mesh, state_spec)
@@ -336,7 +339,9 @@ def _make_1f1b_train_step(mesh, model, loss_fn, state_spec, microbatch):
         h, embed_vjp = jax.vjp(
             lambda ep: model.embed.apply({"params": ep}, x),
             state.params["embed"])
-        loss, tg, hg, dh, aux = spmd_pipeline_1f1b(
+        pipeline = (spmd_pipeline_interleaved if interleaved
+                    else spmd_pipeline_1f1b)
+        loss, tg, hg, dh, aux = pipeline(
             stage_fn, head_loss, state.params["trunk"],
             state.params["head"], h, y, mesh=mesh,
             microbatch_size=microbatch, has_aux=True)
@@ -384,10 +389,17 @@ def _run_spmd_pipelined(spec: WorkloadSpec, config: Config, devices, logger,
     if n_dev % n_stages:
         raise ValueError(f"--nstages {n_stages} must divide the device "
                          f"count {n_dev} (the rest becomes the data axis)")
-    if config.dropout > 0 and config.pipeline_schedule == "1f1b":
-        raise ValueError("--pipeline-schedule 1f1b recomputes forward in "
-                         "its hand-rolled backward and stays deterministic; "
-                         "--dropout needs the gpipe schedule (or -m data)")
+    if config.dropout > 0 and config.pipeline_schedule != "gpipe":
+        raise ValueError(f"--pipeline-schedule {config.pipeline_schedule} "
+                         "recomputes forward in its hand-rolled backward "
+                         "and stays deterministic; --dropout needs the "
+                         "gpipe schedule (or -m data)")
+    if config.pipeline_schedule == "interleaved" and \
+            config.virtual_stages < 2:
+        raise ValueError(f"--pipeline-schedule interleaved needs "
+                         f"--virtual-stages >= 2 (got "
+                         f"{config.virtual_stages}); with one chunk per "
+                         "device use --pipeline-schedule 1f1b")
     if config.grad_compress != "none":
         raise ValueError("--grad-compress targets the pure data-parallel "
                          "gradient all-reduce; the SPMD pipeline's gradient "
@@ -419,11 +431,13 @@ def _run_spmd_pipelined(spec: WorkloadSpec, config: Config, devices, logger,
     train_step, eval_step = make_step_fns(mesh, loss_fn,
                                           state_spec=state_spec,
                                           remat=config.remat)
-    if config.pipeline_schedule == "1f1b":
-        # hand-scheduled interleaved backward: O(stages) activation
-        # residency instead of the scan-transpose's O(microbatches)
-        train_step = _make_1f1b_train_step(mesh, model, loss_fn, state_spec,
-                                           config.microbatch)
+    if config.pipeline_schedule in ("1f1b", "interleaved"):
+        # hand-scheduled backward: O(stages) activation residency instead
+        # of the scan-transpose's O(microbatches); interleaved additionally
+        # fills the bubble with --virtual-stages chunks per device
+        train_step = _make_1f1b_train_step(
+            mesh, model, loss_fn, state_spec, config.microbatch,
+            interleaved=config.pipeline_schedule == "interleaved")
     loaders = make_loaders(dataset, splits, config.batch_size, mesh,
                            seed=config.seed)
     ckpt, start_epoch = _maybe_checkpointer(config)

@@ -113,6 +113,14 @@ RESNET_SPEC = WorkloadSpec(
 )
 
 
+
+
+def _n_chunks(config: Config) -> int:
+    """Chunks per device for the interleaved pipeline schedule (1 = plain
+    stacking for gpipe/1f1b)."""
+    return (config.virtual_stages
+            if config.pipeline_schedule == "interleaved" else 1)
+
 # --- transformer (WMT seq2seq) --------------------------------------------
 
 class Seq2SeqAdapter(nn.Module):
@@ -203,7 +211,8 @@ def _transformer_pipelined(config: Config, dataset, mesh):
                        microbatch_size=config.microbatch,
                        dtype=config_dtype(config),
                        attention_fn=_attention_fn(config),
-                       dropout_rate=config.dropout)
+                       dropout_rate=config.dropout,
+                       n_chunks=_n_chunks(config))
 
 
 def _transformer_layers(config: Config, dataset):
@@ -281,7 +290,8 @@ def _bert_pipelined(config: Config, dataset, mesh):
                        microbatch_size=config.microbatch,
                        dtype=config_dtype(config),
                        attention_fn=_attention_fn(config),
-                       dropout_rate=config.dropout)
+                       dropout_rate=config.dropout,
+                       n_chunks=_n_chunks(config))
 
 
 def _bert_layers(config: Config, dataset):
@@ -416,7 +426,8 @@ def _gpt_pipelined(config: Config, dataset, mesh):
                        max_len=max(dataset.features.shape[1], 4096),
                        dtype=config_dtype(config),
                        attention_fn=_attention_fn(config),
-                       dropout_rate=config.dropout)
+                       dropout_rate=config.dropout,
+                       n_chunks=_n_chunks(config))
 
 
 GPT_SPEC = WorkloadSpec(

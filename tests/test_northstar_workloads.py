@@ -150,3 +150,14 @@ def test_gpt_zero1():
     _, history = _run("gpt", ["-l", "1", "-s", "32", "-e", "1", "-b", "16",
                               "--zero", "1"], limit=128)
     _ok(history)
+
+
+def test_gpt_pipeline_interleaved():
+    """--pipeline-schedule interleaved: V model chunks per device, trunk
+    params stacked (V, S, ...), loss finite and phases complete."""
+    _, history = _run("gpt", ["-l", "4", "-s", "32", "-e", "1", "-b", "16",
+                              "-m", "pipeline", "--nstages", "2",
+                              "--mesh", "stage=2",
+                              "--pipeline-schedule", "interleaved",
+                              "--virtual-stages", "2"], limit=128)
+    _ok(history)
