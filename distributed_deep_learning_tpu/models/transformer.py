@@ -20,6 +20,7 @@ from __future__ import annotations
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -55,9 +56,19 @@ def dot_product_attention(q, k, v, *, mask=None, key_valid=None,
 
 
 class MultiHeadAttention(nn.Module):
+    """Projections + pluggable attention; ``decode=True`` adds a KV cache.
+
+    The cache is created at init time (full-length call shapes the
+    ``cached_key``/``cached_value`` buffers); each subsequent 1-token call
+    appends its K/V at ``cache_index`` and attends the single query
+    against the filled prefix — autoregressive decode costs O(T) per
+    token instead of O(T²) recompute.
+    """
+
     num_heads: int
     dtype: jnp.dtype = jnp.float32
     attention_fn: Optional[AttentionFn] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x_q, x_kv, key_valid=None, *, causal: bool = False,
@@ -69,6 +80,41 @@ class MultiHeadAttention(nn.Module):
             kernel_init=dense_init, name=name)
         q, k, v = proj("q")(x_q), proj("k")(x_kv), proj("v")(x_kv)
         attn = self.attention_fn or dot_product_attention
+        if self.decode:
+            is_init = not self.has_variable("cache", "cached_key")
+            ck = self.variable("cache", "cached_key", jnp.zeros, k.shape,
+                               k.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros, v.shape,
+                               v.dtype)
+            # remember each cached position's padding validity too — the
+            # full forward masks pad tokens, so decode must as well
+            cvalid = self.variable(
+                "cache", "cached_valid",
+                lambda: jnp.zeros(k.shape[:2], jnp.bool_))
+            idx = self.variable("cache", "cache_index",
+                                lambda: jnp.zeros((), jnp.int32))
+            if not is_init:
+                T = q.shape[1]
+                max_len = ck.value.shape[1]
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k, (0, idx.value, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v, (0, idx.value, 0, 0))
+                step_valid = (key_valid if key_valid is not None
+                              else jnp.ones(k.shape[:2], jnp.bool_))
+                cvalid.value = jax.lax.dynamic_update_slice(
+                    cvalid.value, step_valid, (0, idx.value))
+                k, v = ck.value, cv.value
+                key_valid = cvalid.value
+                # causal prefix: query j (global position idx+j) sees key
+                # positions <= idx+j — correct for 1-token steps AND
+                # multi-token prefill chunks
+                qpos = idx.value + jnp.arange(T)
+                mask = (jnp.arange(max_len)[None, None, None, :]
+                        <= qpos[None, None, :, None])
+                idx.value = idx.value + T
+                causal = False
+                attn = dot_product_attention  # fused kernels reject masks
         y = attn(q, k, v, mask=mask, key_valid=key_valid, causal=causal,
                  dtype=self.dtype)
         return nn.DenseGeneral(d_model, axis=(-2, -1), dtype=self.dtype,
@@ -90,12 +136,14 @@ class TransformerLayer(nn.Module):
     cross_attention: bool = False
     dtype: jnp.dtype = jnp.float32
     attention_fn: Optional[AttentionFn] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, encoded=None, *, self_valid=None, cross_valid=None,
                  train: bool = False):
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = MultiHeadAttention(self.num_heads, self.dtype, self.attention_fn,
+                               decode=self.decode,
                                name="self_attn")(h, h, self_valid,
                                                  causal=self.causal)
         h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
@@ -120,6 +168,7 @@ class Embed(nn.Module):
     d_model: int
     max_len: int = 4096
     dtype: jnp.dtype = jnp.float32
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens):
@@ -128,7 +177,19 @@ class Embed(nn.Module):
                        dtype=self.dtype, name="tok")
         pos = self.param("pos", nn.initializers.normal(0.02),
                          (self.max_len, self.d_model))
-        x = emb(tokens) + pos[None, :tokens.shape[1]].astype(self.dtype)
+        T = tokens.shape[1]
+        if self.decode and self.has_variable("cache", "pos_index"):
+            # single-token decode: position = running cache index
+            idx = self.variable("cache", "pos_index",
+                                lambda: jnp.zeros((), jnp.int32))
+            p = jax.lax.dynamic_slice_in_dim(pos, idx.value, T)
+            idx.value = idx.value + T
+        else:
+            if self.decode:  # init pass: create the counter
+                self.variable("cache", "pos_index",
+                              lambda: jnp.zeros((), jnp.int32))
+            p = pos[:T]
+        x = emb(tokens) + p[None].astype(self.dtype)
         return x, emb
 
     @staticmethod
@@ -216,6 +277,7 @@ class CausalLM(nn.Module):
     dropout_rate: float = 0.0
     max_len: int = 8192
     with_logits: bool = False   # True: __call__ returns (B, T, V) logits
+    decode: bool = False        # KV-cached autoregressive decode mode
     dtype: jnp.dtype = jnp.float32
     attention_fn: Optional[AttentionFn] = None
 
@@ -223,12 +285,14 @@ class CausalLM(nn.Module):
     def __call__(self, tokens, train: bool = False):
         valid = tokens != 0
         x, emb = Embed(self.vocab_size, self.d_model, max_len=self.max_len,
-                       dtype=self.dtype, name="embed")(tokens)
+                       dtype=self.dtype, decode=self.decode,
+                       name="embed")(tokens)
         for i in range(self.num_layers):
             x = TransformerLayer(self.num_heads, self.mlp_dim,
                                  self.dropout_rate, causal=True,
                                  dtype=self.dtype,
                                  attention_fn=self.attention_fn,
+                                 decode=self.decode,
                                  name=f"layer_{i}")(x, self_valid=valid,
                                                     train=train)
         x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
@@ -293,3 +357,67 @@ def transformer_base(**kw) -> TransformerSeq2Seq:
 
 def bert_base(**kw) -> BertEncoder:
     return BertEncoder(**kw)
+
+
+def generate(model: "CausalLM", params, prompt: jnp.ndarray, *,
+             max_new_tokens: int, temperature: float = 0.0,
+             rng: jnp.ndarray | None = None) -> jnp.ndarray:
+    """KV-cached autoregressive generation from a trained :class:`CausalLM`.
+
+    ``prompt`` is (B, P) token ids; returns the (B, max_new_tokens)
+    continuation.  Greedy at ``temperature == 0.0``, else samples from
+    ``softmax(logits / temperature)``.  The whole loop is one ``lax.scan``
+    of 1-token cached decode steps (O(T) per token via the attention KV
+    cache; positions follow the cache index) — jit-compatible, static
+    shapes, TPU-friendly.
+
+    The reference has no inference story at all (SURVEY.md: every run is
+    train-then-test); this is part of the LM-family surface a complete
+    framework owes its users.
+
+    The prompt is prefilled in ONE multi-token cached call (the decode
+    path's causal prefix mask keeps in-chunk attention causal), then each
+    new token is a 1-token step.  Pad positions (id 0) inside the prompt
+    are masked out of attention via the cache's validity buffer, but
+    generation always proceeds from each row's FINAL position — prefer
+    unpadded (or left-trimmed) prompts.
+    """
+    lm = model.clone(decode=True, with_logits=True, dropout_rate=0.0)
+    B, P = prompt.shape
+    total = P + max_new_tokens
+    if total > model.max_len:
+        raise ValueError(f"prompt {P} + {max_new_tokens} new tokens "
+                         f"exceeds max_len {model.max_len}")
+    # cache buffers are zeros by construction: shape them via eval_shape
+    # (no full-length forward, no throwaway parameter init)
+    shapes = jax.eval_shape(lm.init, jax.random.key(0),
+                            jax.ShapeDtypeStruct((B, total), prompt.dtype))
+    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                         shapes["cache"])
+    key0 = rng if rng is not None else jax.random.key(0)
+
+    def pick(nl, key):
+        if temperature == 0.0:
+            return jnp.argmax(nl, axis=-1), key
+        key, sub = jax.random.split(key)
+        return jax.random.categorical(sub, nl / temperature), key
+
+    # prefill: the whole prompt in ONE multi-token cached call (the
+    # decode-mode causal prefix mask keeps in-chunk attention causal)
+    logits, upd = lm.apply({"params": params, "cache": cache}, prompt,
+                           mutable=["cache"])
+    first, key0 = pick(logits[:, -1], key0)
+    first = first.astype(prompt.dtype)
+
+    def step(carry, _):
+        cache, tok, key = carry
+        logits, upd = lm.apply({"params": params, "cache": cache},
+                               tok[:, None], mutable=["cache"])
+        nxt, key = pick(logits[:, -1], key)
+        return (upd["cache"], nxt.astype(tok.dtype), key), nxt
+
+    (_, _, _), toks = jax.lax.scan(
+        step, (upd["cache"], first, key0), None, length=max_new_tokens - 1)
+    return jnp.concatenate(
+        [first[:, None], jnp.swapaxes(toks, 0, 1).astype(prompt.dtype)],
+        axis=1)
