@@ -382,7 +382,12 @@ def generate(model: "CausalLM", params, prompt: jnp.ndarray, *,
     generation always proceeds from each row's FINAL position — prefer
     unpadded (or left-trimmed) prompts.
     """
-    lm = model.clone(decode=True, with_logits=True, dropout_rate=0.0)
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got "
+                         f"{max_new_tokens}")
+    # hidden-state mode: project ONLY the final position through the
+    # weight-tied head — prefill never materialises the (B, P, V) logits
+    lm = model.clone(decode=True, with_logits=False, dropout_rate=0.0)
     B, P = prompt.shape
     total = P + max_new_tokens
     if total > model.max_len:
@@ -396,7 +401,8 @@ def generate(model: "CausalLM", params, prompt: jnp.ndarray, *,
                          shapes["cache"])
     key0 = rng if rng is not None else jax.random.key(0)
 
-    def pick(nl, key):
+    def pick(hidden_last, key):
+        nl = model.logits_from({"params": params}, hidden_last)  # (B, V)
         if temperature == 0.0:
             return jnp.argmax(nl, axis=-1), key
         key, sub = jax.random.split(key)
@@ -404,16 +410,16 @@ def generate(model: "CausalLM", params, prompt: jnp.ndarray, *,
 
     # prefill: the whole prompt in ONE multi-token cached call (the
     # decode-mode causal prefix mask keeps in-chunk attention causal)
-    logits, upd = lm.apply({"params": params, "cache": cache}, prompt,
+    hidden, upd = lm.apply({"params": params, "cache": cache}, prompt,
                            mutable=["cache"])
-    first, key0 = pick(logits[:, -1], key0)
+    first, key0 = pick(hidden[:, -1], key0)
     first = first.astype(prompt.dtype)
 
     def step(carry, _):
         cache, tok, key = carry
-        logits, upd = lm.apply({"params": params, "cache": cache},
+        hidden, upd = lm.apply({"params": params, "cache": cache},
                                tok[:, None], mutable=["cache"])
-        nxt, key = pick(logits[:, -1], key)
+        nxt, key = pick(hidden[:, -1], key)
         return (upd["cache"], nxt.astype(tok.dtype), key), nxt
 
     (_, _, _), toks = jax.lax.scan(

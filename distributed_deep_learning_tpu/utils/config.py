@@ -146,6 +146,8 @@ class Config:
     image_size: int = 224               # decode size for --data-dir images
     stem_s2d: bool = False              # space-to-depth ResNet stem (TPU opt)
     attention: str = "auto"             # auto|dense|flash (transformer family)
+    optimizer: str = "auto"             # auto|sgd|momentum|adam|adamw|...
+    generate_tokens: int = 0            # gpt: sample N tokens post-train
     pipeline_schedule: str = "gpipe"    # gpipe | 1f1b | interleaved
     virtual_stages: int = 2             # chunks/device (interleaved)
     lr_schedule: str = "none"           # none|cosine|rsqrt|step (north stars)
@@ -264,6 +266,22 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
                    help="attention implementation for transformer-family "
                         "models: auto = Pallas flash kernel on TPU, dense "
                         "elsewhere")
+    p.add_argument("--optimizer",
+                   choices=["auto", "sgd", "momentum", "adam", "adamw",
+                            "adafactor", "lamb"],
+                   default="auto",
+                   help="override the workload's default optimizer: "
+                        "adafactor = sublinear-memory factored second "
+                        "moments (the TPU big-model staple), lamb = "
+                        "layerwise-adaptive large-batch; auto keeps the "
+                        "per-workload recipe (sgd+momentum for vision, "
+                        "adamw for LMs)")
+    p.add_argument("--generate", dest="generate_tokens", type=int,
+                   default=0, metavar="N",
+                   help="gpt: after training, print N-token greedy "
+                        "continuations of two dataset prompts (KV-cached "
+                        "decode; a smoke sample — the prompts are usually "
+                        "training rows, not held-out data)")
     p.add_argument("--schedule", dest="lr_schedule",
                    choices=["none", "cosine", "rsqrt", "step"],
                    default="none",
@@ -348,6 +366,8 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         image_size=args.image_size,
         stem_s2d=args.stem_s2d,
         attention=args.attention,
+        optimizer=args.optimizer,
+        generate_tokens=args.generate_tokens,
         pipeline_schedule=args.pipeline_schedule,
         virtual_stages=args.virtual_stages,
         lr_schedule=args.lr_schedule,

@@ -76,11 +76,39 @@ class WorkloadSpec:
     # `-m pipeline` runs the SPMD pipeline (stage mesh axis, one XLA
     # program) instead of MPMD staging
     build_pipelined: Callable[[Config, Any, Any], Any] | None = None
+    # optional: (config, final_state, logger, dataset) hook after
+    # training — e.g. the gpt workload's --generate sample printer
+    post_train: Callable[[Config, Any, Any, Any], None] | None = None
 
 
 def config_dtype(config: Config) -> jnp.dtype:
     """The compute dtype the ``--dtype`` flag selects."""
     return jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+
+
+def build_optimizer(spec: "WorkloadSpec", config: Config, epoch_steps: int
+                    ) -> optax.GradientTransformation:
+    """The workload's optimizer recipe, overridable by ``--optimizer``.
+
+    ``auto`` keeps the per-workload default (sgd+momentum for vision,
+    adamw for the LM families — matching each reference main's choice);
+    anything else builds that optax transform at ``--lr`` with the
+    ``--schedule`` machinery applied.  ``adafactor`` is the TPU big-model
+    staple: factored second moments give sublinear optimizer memory, and
+    its state composes with ``--zero`` (the sharding specs are derived by
+    walking the actual state pytree, whatever its structure).
+    """
+    if config.optimizer == "auto":
+        return spec.build_optimizer(config, epoch_steps)
+    lr = resolve_lr(config, epoch_steps, config.learning_rate)
+    return {
+        "sgd": lambda: optax.sgd(lr),
+        "momentum": lambda: optax.sgd(lr, momentum=0.9),
+        "adam": lambda: optax.adam(lr),
+        "adamw": lambda: optax.adamw(lr),
+        "adafactor": lambda: optax.adafactor(learning_rate=lr),
+        "lamb": lambda: optax.lamb(lr),
+    }[config.optimizer]()
 
 
 def resolve_lr(config: Config, epoch_steps: int, base_lr: float):
@@ -474,15 +502,24 @@ def run_workload(spec: WorkloadSpec, config: Config
     devices = _devices(config)
     logger = PhaseLogger(verbose=is_coordinator(),
                          jsonl_path=config.metrics_file)
+    if config.generate_tokens and spec.post_train is None:
+        # rejected, not silently dropped (same principle as staged-mode
+        # flag validation below)
+        raise ValueError(f"--generate is not supported by workload "
+                         f"{spec.name!r} (gpt only)")
     try:
-        return _run_workload(spec, config, devices, logger)
+        dataset = spec.build_dataset(config)
+        state, history = _run_workload(spec, config, devices, logger,
+                                       dataset)
+        if config.generate_tokens and spec.post_train is not None:
+            spec.post_train(config, state, logger, dataset)
+        return state, history
     finally:
         logger.close()
 
 
-def _run_workload(spec: WorkloadSpec, config: Config, devices, logger
-                  ) -> tuple[Any, list[EpochResult]]:
-    dataset = spec.build_dataset(config)
+def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
+                  dataset) -> tuple[Any, list[EpochResult]]:
     # DDL_DATA_LIMIT caps the examples considered (CI / smoke runs)
     import os
     limit = int(os.environ.get("DDL_DATA_LIMIT", "0"))
@@ -491,7 +528,7 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger
     example = spec.example_input(config, dataset)
     loss_fn = spec.build_loss(config)
     epoch_steps = max(1, len(splits.train) // config.batch_size)
-    tx = spec.build_optimizer(config, epoch_steps)
+    tx = build_optimizer(spec, config, epoch_steps)
     if config.clip_norm:
         # applied before the optimizer transform; in staged MPMD modes the
         # per-stage updates make this a per-stage norm (documented on the
@@ -533,7 +570,7 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger
                     val=shard_indices(splits.val, n, 0),
                     test=shard_indices(splits.test, n, 0))
                 epoch_steps = max(1, len(splits.train) // config.batch_size)
-                tx = spec.build_optimizer(config, epoch_steps)
+                tx = build_optimizer(spec, config, epoch_steps)
             else:
                 mesh = build_mesh({"data": n}, devices[:n])
         loaders = make_loaders(dataset, splits, config.batch_size, mesh,

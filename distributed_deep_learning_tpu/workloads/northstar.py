@@ -430,6 +430,30 @@ def _gpt_pipelined(config: Config, dataset, mesh):
                        n_chunks=_n_chunks(config))
 
 
+def _gpt_generate(config: Config, state, logger, dataset) -> None:
+    """``--generate N``: print KV-cached greedy continuations of two
+    dataset prompts (rows 0-1 — typically TRAINING rows after the
+    shuffled split, so treat the output as a smoke sample, not held-out
+    evaluation) in the reference's quote-delimited log style."""
+    from distributed_deep_learning_tpu.models.transformer import generate
+
+    params = getattr(state, "params", None)
+    if isinstance(params, dict) and "params" in params:
+        params = params["params"]
+    if not isinstance(params, dict) or "embed" not in params:
+        # staged/pipelined states carry per-stage param lists, not the
+        # CausalLM tree — a notice, not a crash, after a finished run
+        logger.info("generate skipped: --generate needs the whole-model "
+                    "parameter tree (-m data or sequential)")
+        return
+    model = _gpt_model(config, dataset)
+    prompts = jnp.asarray(dataset.features[:2, :8], jnp.int32)
+    out = generate(model, params, prompts,
+                   max_new_tokens=config.generate_tokens)
+    for row_p, row_o in zip(prompts.tolist(), out.tolist()):
+        logger.info(f"generate prompt={row_p} continuation={row_o}")
+
+
 GPT_SPEC = WorkloadSpec(
     name="gpt",
     build_dataset=_gpt_dataset,
@@ -443,6 +467,7 @@ GPT_SPEC = WorkloadSpec(
                                           jnp.int32),
     tp_rules=lambda c: transformer_tp_rules(),
     build_pipelined=_gpt_pipelined,
+    post_train=_gpt_generate,
 )
 
 SPECS = {"resnet": RESNET_SPEC, "transformer": TRANSFORMER_SPEC,

@@ -161,3 +161,37 @@ def test_gpt_pipeline_interleaved():
                               "--pipeline-schedule", "interleaved",
                               "--virtual-stages", "2"], limit=128)
     _ok(history)
+
+
+def test_optimizer_override_adafactor():
+    """--optimizer adafactor trains (sublinear-memory factored state) and
+    composes with --zero 1 (specs derived from the actual state pytree)."""
+    _, h = _run("gpt", ["-l", "1", "-s", "32", "-e", "1", "-b", "16",
+                        "--optimizer", "adafactor", "--lr", "1e-2"],
+                limit=128)
+    _ok(h)
+    _, h = _run("gpt", ["-l", "1", "-s", "32", "-e", "1", "-b", "16",
+                        "--optimizer", "adafactor", "--zero", "1"],
+                limit=128)
+    _ok(h)
+
+
+def test_optimizer_override_lamb():
+    _, h = _run("resnet", ["-s", "18", "-e", "1", "-b", "32",
+                           "--optimizer", "lamb", "--lr", "1e-3"], limit=128)
+    _ok(h)
+
+
+def test_gpt_generate_flag(capsys):
+    """--generate N prints prompt/continuation lines post-train."""
+    _, h = _run("gpt", ["-l", "1", "-s", "32", "-e", "1", "-b", "16",
+                        "--generate", "4"], limit=128)
+    _ok(h)
+    out = capsys.readouterr().out
+    assert "generate prompt=" in out and "continuation=" in out
+
+
+def test_generate_flag_rejected_for_non_gpt():
+    with pytest.raises(ValueError, match="--generate"):
+        _run("transformer", ["-l", "1", "-s", "32", "-e", "1", "-b", "16",
+                             "--generate", "4"], limit=128)
