@@ -397,6 +397,9 @@ def orchestrate() -> int:
         ({"BENCH_BATCH_PER_CHIP": "256"}, base),
         ({"BENCH_BATCH_PER_CHIP": "128"}, base * 0.4),
         ({"BENCH_BATCH_PER_CHIP": "64"}, base * 0.3),
+        # insurance against a TPU-specific s2d-stem compile failure: one
+        # attempt with the plain 7x7 stem before giving up the chip
+        ({"BENCH_BATCH_PER_CHIP": "128", "BENCH_S2D": "0"}, base * 0.4),
     ]
     attempts.append(cpu_attempt)
     timeouts = 0
