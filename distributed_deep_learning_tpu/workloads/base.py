@@ -86,6 +86,19 @@ def config_dtype(config: Config) -> jnp.dtype:
     return jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
 
 
+def _decay_mask(params):
+    """Standard AdamW/LAMB recipe: weight decay applies to matrices and
+    conv kernels only — biases, LayerNorm/BatchNorm scales and other
+    vectors (ndim < 2) are exempt."""
+    return jax.tree.map(lambda p: jnp.ndim(p) >= 2, params)
+
+
+def adamw(learning_rate, weight_decay: float = 1e-4):
+    """``optax.adamw`` with the bias/norm decay exemption applied."""
+    return optax.adamw(learning_rate, weight_decay=weight_decay,
+                       mask=_decay_mask)
+
+
 def build_optimizer(spec: "WorkloadSpec", config: Config, epoch_steps: int
                     ) -> optax.GradientTransformation:
     """The workload's optimizer recipe, overridable by ``--optimizer``.
@@ -105,9 +118,9 @@ def build_optimizer(spec: "WorkloadSpec", config: Config, epoch_steps: int
         "sgd": lambda: optax.sgd(lr),
         "momentum": lambda: optax.sgd(lr, momentum=0.9),
         "adam": lambda: optax.adam(lr),
-        "adamw": lambda: optax.adamw(lr),
+        "adamw": lambda: adamw(lr),
         "adafactor": lambda: optax.adafactor(learning_rate=lr),
-        "lamb": lambda: optax.lamb(lr),
+        "lamb": lambda: optax.lamb(lr, mask=_decay_mask),
     }[config.optimizer]()
 
 

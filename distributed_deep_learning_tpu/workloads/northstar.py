@@ -45,6 +45,7 @@ from distributed_deep_learning_tpu.train.objectives import (
     cross_entropy_loss, token_cross_entropy)
 from distributed_deep_learning_tpu.utils.config import Config, parse_args
 from distributed_deep_learning_tpu.workloads.base import (WorkloadSpec,
+                                                          adamw,
                                                           config_dtype,
                                                           example_from_dataset,
                                                           resolve_lr,
@@ -240,7 +241,7 @@ TRANSFORMER_SPEC = WorkloadSpec(
     build_layers=_transformer_layers,
     partitioner=balanced_partition,
     build_loss=lambda c: token_cross_entropy,
-    build_optimizer=lambda c, steps: optax.adamw(
+    build_optimizer=lambda c, steps: adamw(
         resolve_lr(c, steps, c.learning_rate)),
     example_input=lambda c, ds: jnp.zeros((1, ds.features.shape[1]),
                                           jnp.int32),
@@ -317,7 +318,7 @@ BERT_SPEC = WorkloadSpec(
     build_layers=_bert_layers,
     partitioner=balanced_partition,
     build_loss=lambda c: token_cross_entropy,
-    build_optimizer=lambda c, steps: optax.adamw(
+    build_optimizer=lambda c, steps: adamw(
         resolve_lr(c, steps, c.learning_rate)),
     example_input=lambda c, ds: jnp.zeros((1, ds.features.shape[1]),
                                           jnp.int32),
@@ -361,7 +362,7 @@ MOE_SPEC = WorkloadSpec(
     build_layers=_moe_no_staging,
     partitioner=lambda n, s: np.zeros(n, np.int64),
     build_loss=lambda c: token_cross_entropy,
-    build_optimizer=lambda c, steps: optax.adamw(
+    build_optimizer=lambda c, steps: adamw(
         resolve_lr(c, steps, c.learning_rate)),
     example_input=lambda c, ds: jnp.zeros((1, ds.features.shape[1]),
                                           jnp.int32),
@@ -461,7 +462,7 @@ GPT_SPEC = WorkloadSpec(
     build_layers=_gpt_layers,
     partitioner=balanced_partition,
     build_loss=lambda c: token_cross_entropy,
-    build_optimizer=lambda c, steps: optax.adamw(
+    build_optimizer=lambda c, steps: adamw(
         resolve_lr(c, steps, c.learning_rate)),
     example_input=lambda c, ds: jnp.zeros((1, ds.features.shape[1]),
                                           jnp.int32),

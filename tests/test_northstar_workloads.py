@@ -195,3 +195,17 @@ def test_generate_flag_rejected_for_non_gpt():
     with pytest.raises(ValueError, match="--generate"):
         _run("transformer", ["-l", "1", "-s", "32", "-e", "1", "-b", "16",
                              "--generate", "4"], limit=128)
+
+
+def test_adamw_decay_mask_exempts_vectors():
+    """Weight decay must skip biases/norm scales (ndim < 2)."""
+    import jax.numpy as jnp
+
+    from distributed_deep_learning_tpu.workloads.base import _decay_mask
+
+    tree = {"dense": {"kernel": jnp.zeros((4, 4)), "bias": jnp.zeros((4,))},
+            "ln": {"scale": jnp.zeros((4,))}}
+    m = _decay_mask(tree)
+    assert m["dense"]["kernel"] is True or m["dense"]["kernel"] == True  # noqa: E712
+    assert not m["dense"]["bias"]
+    assert not m["ln"]["scale"]
