@@ -55,6 +55,31 @@ def dot_product_attention(q, k, v, *, mask=None, key_valid=None,
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,
+               base: float = 10000.0) -> jnp.ndarray:
+    """Rotary position embedding on ``(B, T, H, D)`` (D even).
+
+    Rotates feature pairs ``(x[..., :D/2], x[..., D/2:])`` by
+    ``position · base^(-2i/D)`` — attention then depends on RELATIVE
+    positions only.  Parameter-free, so tensor-parallel sharding rules
+    and the weight-tied head are untouched; the KV-cache decode path
+    passes ``positions = cache_index + arange(T)`` so cached keys carry
+    their absolute rotation.
+    """
+    if x.shape[-1] % 2:
+        raise ValueError(f"RoPE requires an even head_dim, got "
+                         f"{x.shape[-1]} (pick num_heads so that "
+                         "d_model/num_heads is even)")
+    d2 = x.shape[-1] // 2
+    freqs = base ** (-jnp.arange(d2, dtype=jnp.float32) / d2)    # (d2,)
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None]   # (T, d2)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = x[..., :d2].astype(jnp.float32), x[..., d2:].astype(jnp.float32)
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], -1).astype(x.dtype)
+
+
 class MultiHeadAttention(nn.Module):
     """Projections + pluggable attention; ``decode=True`` adds a KV cache.
 
@@ -69,6 +94,7 @@ class MultiHeadAttention(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attention_fn: Optional[AttentionFn] = None
     decode: bool = False
+    rope: bool = False
 
     @nn.compact
     def __call__(self, x_q, x_kv, key_valid=None, *, causal: bool = False,
@@ -79,6 +105,13 @@ class MultiHeadAttention(nn.Module):
             (self.num_heads, head_dim), dtype=self.dtype,
             kernel_init=dense_init, name=name)
         q, k, v = proj("q")(x_q), proj("k")(x_kv), proj("v")(x_kv)
+        if self.rope:
+            start = jnp.zeros((), jnp.int32)
+            if self.decode and self.has_variable("cache", "cache_index"):
+                start = self.get_variable("cache", "cache_index")
+            positions = start + jnp.arange(q.shape[1])
+            q = apply_rope(q, positions)
+            k = apply_rope(k, positions)  # cached K carry their rotation
         attn = self.attention_fn or dot_product_attention
         if self.decode:
             is_init = not self.has_variable("cache", "cached_key")
@@ -137,13 +170,14 @@ class TransformerLayer(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attention_fn: Optional[AttentionFn] = None
     decode: bool = False
+    rope: bool = False
 
     @nn.compact
     def __call__(self, x, encoded=None, *, self_valid=None, cross_valid=None,
                  train: bool = False):
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = MultiHeadAttention(self.num_heads, self.dtype, self.attention_fn,
-                               decode=self.decode,
+                               decode=self.decode, rope=self.rope,
                                name="self_attn")(h, h, self_valid,
                                                  causal=self.causal)
         h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
@@ -169,12 +203,15 @@ class Embed(nn.Module):
     max_len: int = 4096
     dtype: jnp.dtype = jnp.float32
     decode: bool = False
+    use_pos: bool = True   # False: no learned positions (RoPE models)
 
     @nn.compact
     def __call__(self, tokens):
         emb = nn.Embed(self.vocab_size, self.d_model,
                        embedding_init=nn.initializers.normal(0.02),
                        dtype=self.dtype, name="tok")
+        if not self.use_pos:
+            return emb(tokens), emb
         pos = self.param("pos", nn.initializers.normal(0.02),
                          (self.max_len, self.d_model))
         T = tokens.shape[1]
@@ -278,21 +315,23 @@ class CausalLM(nn.Module):
     max_len: int = 8192
     with_logits: bool = False   # True: __call__ returns (B, T, V) logits
     decode: bool = False        # KV-cached autoregressive decode mode
+    pos_embedding: str = "learned"   # learned | rope
     dtype: jnp.dtype = jnp.float32
     attention_fn: Optional[AttentionFn] = None
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
         valid = tokens != 0
+        rope = self.pos_embedding == "rope"
         x, emb = Embed(self.vocab_size, self.d_model, max_len=self.max_len,
                        dtype=self.dtype, decode=self.decode,
-                       name="embed")(tokens)
+                       use_pos=not rope, name="embed")(tokens)
         for i in range(self.num_layers):
             x = TransformerLayer(self.num_heads, self.mlp_dim,
                                  self.dropout_rate, causal=True,
                                  dtype=self.dtype,
                                  attention_fn=self.attention_fn,
-                                 decode=self.decode,
+                                 decode=self.decode, rope=rope,
                                  name=f"layer_{i}")(x, self_valid=valid,
                                                     train=train)
         x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)

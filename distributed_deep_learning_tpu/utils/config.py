@@ -148,6 +148,7 @@ class Config:
     attention: str = "auto"             # auto|dense|flash (transformer family)
     optimizer: str = "auto"             # auto|sgd|momentum|adam|adamw|...
     generate_tokens: int = 0            # gpt: sample N tokens post-train
+    pos_embedding: str = "learned"      # learned | rope (gpt)
     pipeline_schedule: str = "gpipe"    # gpipe | 1f1b | interleaved
     virtual_stages: int = 2             # chunks/device (interleaved)
     lr_schedule: str = "none"           # none|cosine|rsqrt|step (north stars)
@@ -276,6 +277,10 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
                         "layerwise-adaptive large-batch; auto keeps the "
                         "per-workload recipe (sgd+momentum for vision, "
                         "adamw for LMs)")
+    p.add_argument("--pos", dest="pos_embedding",
+                   choices=["learned", "rope"], default="learned",
+                   help="gpt position encoding: learned absolute table or "
+                        "parameter-free rotary (RoPE, relative positions)")
     p.add_argument("--generate", dest="generate_tokens", type=int,
                    default=0, metavar="N",
                    help="gpt: after training, print N-token greedy "
@@ -368,6 +373,7 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         attention=args.attention,
         optimizer=args.optimizer,
         generate_tokens=args.generate_tokens,
+        pos_embedding=args.pos_embedding,
         pipeline_schedule=args.pipeline_schedule,
         virtual_stages=args.virtual_stages,
         lr_schedule=args.lr_schedule,

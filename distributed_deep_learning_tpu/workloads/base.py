@@ -120,7 +120,9 @@ def build_optimizer(spec: "WorkloadSpec", config: Config, epoch_steps: int
         "adam": lambda: optax.adam(lr),
         "adamw": lambda: adamw(lr),
         "adafactor": lambda: optax.adafactor(learning_rate=lr),
-        "lamb": lambda: optax.lamb(lr, mask=_decay_mask),
+        # optax.lamb defaults weight_decay to 0.0 — pass the canonical
+        # LAMB decay explicitly or the mask would exempt nothing
+        "lamb": lambda: optax.lamb(lr, weight_decay=1e-2, mask=_decay_mask),
     }[config.optimizer]()
 
 
@@ -520,6 +522,15 @@ def run_workload(spec: WorkloadSpec, config: Config
         # flag validation below)
         raise ValueError(f"--generate is not supported by workload "
                          f"{spec.name!r} (gpt only)")
+    if config.pos_embedding != "learned" and spec.name != "gpt":
+        raise ValueError(f"--pos {config.pos_embedding} is a gpt option; "
+                         f"workload {spec.name!r} uses its own position "
+                         "scheme")
+    if config.pos_embedding != "learned" and config.mode in (
+            Mode.MODEL, Mode.PIPELINE):
+        raise ValueError("--pos rope is implemented for the whole-model "
+                         "modes (-m data/sequential); staged/pipelined gpt "
+                         "trunks use learned positions")
     try:
         dataset = spec.build_dataset(config)
         state, history = _run_workload(spec, config, devices, logger,

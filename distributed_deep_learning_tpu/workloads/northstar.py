@@ -394,6 +394,7 @@ def _gpt_model(config: Config, dataset):
                     num_heads=max(2, d // 64), mlp_dim=4 * d,
                     dropout_rate=config.dropout, with_logits=True,
                     max_len=max(dataset.features.shape[1], 8),
+                    pos_embedding=config.pos_embedding,
                     dtype=config_dtype(config),
                     attention_fn=_attention_fn(config))
 

@@ -118,3 +118,45 @@ def test_multi_token_prefill_matches_full_forward():
         np.testing.assert_allclose(np.asarray(step_logits[:, 0]),
                                    np.asarray(full[:, t]),
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_rope_causal_lm_trains_and_is_causal():
+    """pos_embedding='rope': no learned position table, causality holds."""
+    model = _model(with_logits=True, pos_embedding="rope")
+    toks = jax.random.randint(jax.random.key(12), (2, 16), 1, 61)
+    params = model.init(jax.random.key(13), toks)["params"]
+    assert "pos" not in params["embed"], "rope must not create a pos table"
+    t2 = toks.at[:, 10:].set(1 + (toks[:, 10:] % 60))
+    h1 = model.apply({"params": params}, toks)
+    h2 = model.apply({"params": params}, t2)
+    np.testing.assert_allclose(np.asarray(h1[:, :10]),
+                               np.asarray(h2[:, :10]), rtol=2e-4, atol=2e-4)
+
+
+def test_rope_cached_decode_matches_full_forward():
+    """RoPE + KV cache: cached keys carry their absolute rotation, so
+    per-step decode logits must equal the full forward."""
+    model = _model(with_logits=True, pos_embedding="rope")
+    toks = jax.random.randint(jax.random.key(14), (2, 10), 1, 61)
+    params = model.init(jax.random.key(15), toks)["params"]
+    full = model.apply({"params": params}, toks)
+
+    lm = model.clone(decode=True)
+    shapes = jax.eval_shape(lm.init, jax.random.key(0), toks)
+    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                         shapes["cache"])
+    for t in range(toks.shape[1]):
+        step_logits, upd = lm.apply({"params": params, "cache": cache},
+                                    toks[:, t:t + 1], mutable=["cache"])
+        cache = upd["cache"]
+        np.testing.assert_allclose(np.asarray(step_logits[:, 0]),
+                                   np.asarray(full[:, t]),
+                                   rtol=3e-4, atol=3e-4)
+
+
+def test_rope_generate_runs():
+    model = _model(with_logits=True, pos_embedding="rope")
+    prompt = jax.random.randint(jax.random.key(16), (2, 4), 1, 61)
+    params = model.init(jax.random.key(17), prompt)["params"]
+    out = generate(model, params, prompt, max_new_tokens=5)
+    assert out.shape == (2, 5)

@@ -209,3 +209,19 @@ def test_adamw_decay_mask_exempts_vectors():
     assert m["dense"]["kernel"] is True or m["dense"]["kernel"] == True  # noqa: E712
     assert not m["dense"]["bias"]
     assert not m["ln"]["scale"]
+
+
+def test_pos_rope_rejected_where_unsupported():
+    with pytest.raises(ValueError, match="--pos"):
+        _run("transformer", ["-l", "1", "-s", "32", "-e", "1", "-b", "16",
+                             "--pos", "rope"], limit=128)
+    with pytest.raises(ValueError, match="--pos rope"):
+        _run("gpt", ["-l", "2", "-s", "32", "-e", "1", "-b", "16",
+                     "-m", "pipeline", "--nstages", "2", "--pos", "rope"],
+             limit=128)
+
+
+def test_gpt_rope_trains():
+    _, h = _run("gpt", ["-l", "1", "-s", "64", "-e", "1", "-b", "32",
+                        "--pos", "rope"], limit=512)
+    _ok(h)
