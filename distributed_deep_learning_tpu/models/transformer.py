@@ -29,13 +29,15 @@ dense_init = nn.initializers.xavier_uniform()
 
 
 def dot_product_attention(q, k, v, *, mask=None, key_valid=None,
-                          causal=False, dtype=jnp.float32):
+                          causal=False, window=None, dtype=jnp.float32):
     """Plain softmax attention; q/k/v are (B, T, H, D).
 
     Masking follows the structured convention shared with the flash and
     ring implementations: ``key_valid`` is a (B, Tk) boolean padding mask,
-    ``causal`` a flag; a pre-built dense ``mask`` (broadcastable to
-    (B, H, Tq, Tk)) is also accepted here and combined.
+    ``causal`` a flag, ``window`` an optional causal sliding-window size
+    (each query sees its last ``window`` positions); a pre-built dense
+    ``mask`` (broadcastable to (B, H, Tq, Tk)) is also accepted and
+    combined.
     """
     depth = q.shape[-1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(depth)
@@ -45,6 +47,13 @@ def dot_product_attention(q, k, v, *, mask=None, key_valid=None,
     if causal:
         tril = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))[None, None]
         mask = tril if mask is None else jnp.logical_and(mask, tril)
+    if window is not None:
+        if not causal and mask is None:
+            raise ValueError("window requires causal attention")
+        qp = jnp.arange(q.shape[1])[:, None]
+        kp = jnp.arange(k.shape[1])[None, :]
+        band = ((qp - kp) < window)[None, None]
+        mask = band if mask is None else jnp.logical_and(mask, band)
     if mask is not None:
         # -1e9, not finfo(f32).min: the latter overflows to -inf in bf16
         # (same exponent range, smaller mantissa → rounds past bf16 max) and
@@ -95,6 +104,7 @@ class MultiHeadAttention(nn.Module):
     attention_fn: Optional[AttentionFn] = None
     decode: bool = False
     rope: bool = False
+    window: Optional[int] = None   # causal sliding-window size
 
     @nn.compact
     def __call__(self, x_q, x_kv, key_valid=None, *, causal: bool = False,
@@ -143,13 +153,24 @@ class MultiHeadAttention(nn.Module):
                 # positions <= idx+j — correct for 1-token steps AND
                 # multi-token prefill chunks
                 qpos = idx.value + jnp.arange(T)
-                mask = (jnp.arange(max_len)[None, None, None, :]
-                        <= qpos[None, None, :, None])
+                kpos = jnp.arange(max_len)[None, None, None, :]
+                mask = kpos <= qpos[None, None, :, None]
+                if self.window is not None:
+                    # the trained model never attends beyond its window —
+                    # decode must not either (train/inference parity)
+                    mask = jnp.logical_and(
+                        mask,
+                        qpos[None, None, :, None] - kpos < self.window)
                 idx.value = idx.value + T
                 causal = False
                 attn = dot_product_attention  # fused kernels reject masks
+        kw = {}
+        if self.window is not None and mask is None:
+            # structured convention: window rides alongside causal so the
+            # flash kernel can bound its key loops instead of masking
+            kw["window"] = self.window
         y = attn(q, k, v, mask=mask, key_valid=key_valid, causal=causal,
-                 dtype=self.dtype)
+                 dtype=self.dtype, **kw)
         return nn.DenseGeneral(d_model, axis=(-2, -1), dtype=self.dtype,
                                kernel_init=dense_init, name="out")(y)
 
@@ -171,6 +192,7 @@ class TransformerLayer(nn.Module):
     attention_fn: Optional[AttentionFn] = None
     decode: bool = False
     rope: bool = False
+    window: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, encoded=None, *, self_valid=None, cross_valid=None,
@@ -178,6 +200,7 @@ class TransformerLayer(nn.Module):
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = MultiHeadAttention(self.num_heads, self.dtype, self.attention_fn,
                                decode=self.decode, rope=self.rope,
+                               window=self.window,
                                name="self_attn")(h, h, self_valid,
                                                  causal=self.causal)
         h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
@@ -316,6 +339,7 @@ class CausalLM(nn.Module):
     with_logits: bool = False   # True: __call__ returns (B, T, V) logits
     decode: bool = False        # KV-cached autoregressive decode mode
     pos_embedding: str = "learned"   # learned | rope
+    attention_window: Optional[int] = None  # causal sliding window
     dtype: jnp.dtype = jnp.float32
     attention_fn: Optional[AttentionFn] = None
 
@@ -332,6 +356,7 @@ class CausalLM(nn.Module):
                                  dtype=self.dtype,
                                  attention_fn=self.attention_fn,
                                  decode=self.decode, rope=rope,
+                                 window=self.attention_window,
                                  name=f"layer_{i}")(x, self_valid=valid,
                                                     train=train)
         x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)

@@ -60,10 +60,19 @@ def _dot(a, b, dims, out_dtype=jnp.float32):
                            preferred_element_type=out_dtype)
 
 
-def _causal_mask(s, q_off, k_off, bq, bk):
+def _causal_mask(s, q_off, k_off, bq, bk, window=None):
     q_pos = q_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_pos = k_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+    ok = q_pos >= k_pos
+    if window is not None:
+        # sliding window: each query sees its last `window` positions
+        ok = jnp.logical_and(ok, q_pos - k_pos < window)
+    return jnp.where(ok, s, NEG_INF)
+
+
+def _window_lo(q_off, window, block_k):
+    """First key block a windowed query block can touch."""
+    return jnp.maximum(0, q_off - (window - 1)) // block_k
 
 
 # --------------------------------------------------------------------------
@@ -86,7 +95,8 @@ def drop_kv(kern, n_fixed):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, kv_ref, o_ref, lse_ref, *,
-                sm_scale: float, causal: bool, block_k: int, k_len: int):
+                sm_scale: float, causal: bool, block_k: int, k_len: int,
+                window: int | None = None):
     q = q_ref[0]                                     # (bq, D), input dtype
     bq, d = q.shape
     q_off = pl.program_id(1) * bq
@@ -101,7 +111,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kv_ref, o_ref, lse_ref, *,
         v = v_ref[0, pl.ds(i * block_k, block_k), :]
         s = _dot(q, k, ((1,), (1,))) * sm_scale      # (bq, bk) f32
         if causal:
-            s = _causal_mask(s, q_off, i * block_k, bq, block_k)
+            s = _causal_mask(s, q_off, i * block_k, bq, block_k, window)
         if kv_ref is not None:
             valid = kv_ref[0, :, pl.ds(i * block_k, block_k)]  # (1, bk) f32
             s = jnp.where(valid > 0, s, NEG_INF)
@@ -114,11 +124,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kv_ref, o_ref, lse_ref, *,
         return new_m, new_l, acc * corr + pv
 
     n_blocks = k_len // block_k
+    lo = 0
     if causal:
         # stop at the diagonal: key blocks fully above it are all-masked
         n_blocks = jnp.minimum(n_blocks,
                                (q_off + bq + block_k - 1) // block_k)
-    m, l, acc = lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+        if window is not None:
+            # sliding window: skip key blocks fully below it too
+            lo = _window_lo(q_off, window, block_k)
+    m, l, acc = lax.fori_loop(lo, n_blocks, body, (m0, l0, acc0))
     # all-keys-masked rows (fully-padded sequence) degrade to uniform
     # attention over the visited key blocks (the dense path averages over
     # all Tk; same spirit, padded-row values are garbage either way) —
@@ -143,14 +157,15 @@ def _fit_block(length: int, requested: int) -> int:
 
 
 def _flash_fwd(q, k, v, kvalid, sm_scale, causal, block_q, block_k,
-               interpret):
+               interpret, window=None):
     BH, Tq, D = q.shape
     Tk = k.shape[1]
     block_q = _fit_block(Tq, block_q)
     block_k = _fit_block(Tk, block_k)
     kernel = functools.partial(
         _fwd_kernel if kvalid is not None else drop_kv(_fwd_kernel, 3),
-        sm_scale=sm_scale, causal=causal, block_k=block_k, k_len=Tk)
+        sm_scale=sm_scale, causal=causal, block_k=block_k, k_len=Tk,
+        window=window)
     in_specs = [
         pl.BlockSpec((1, block_q, D), lambda b, qi: (b, qi, 0),
                      memory_space=pltpu.VMEM),
@@ -189,7 +204,7 @@ def _flash_fwd(q, k, v, kvalid, sm_scale, causal, block_q, block_k,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kv_ref,
                dq_ref, *, sm_scale: float, causal: bool, block_k: int,
-               k_len: int):
+               k_len: int, window: int | None = None):
     q = q_ref[0]                                     # (bq, D)
     do = do_ref[0]
     bq, d = q.shape
@@ -202,7 +217,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kv_ref,
         v = v_ref[0, pl.ds(i * block_k, block_k), :]
         s = _dot(q, k, ((1,), (1,))) * sm_scale
         if causal:
-            s = _causal_mask(s, q_off, i * block_k, bq, block_k)
+            s = _causal_mask(s, q_off, i * block_k, bq, block_k, window)
         if kv_ref is not None:
             valid = kv_ref[0, :, pl.ds(i * block_k, block_k)]  # (1, bk)
             s = jnp.where(valid > 0, s, NEG_INF)
@@ -212,16 +227,19 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kv_ref,
         return acc + _dot(ds.astype(k.dtype), k, ((1,), (0,)))
 
     n_blocks = k_len // block_k
+    lo = 0
     if causal:
         n_blocks = jnp.minimum(n_blocks,
                                (q_off + bq + block_k - 1) // block_k)
-    acc = lax.fori_loop(0, n_blocks, body, jnp.zeros((bq, d), jnp.float32))
+        if window is not None:
+            lo = _window_lo(q_off, window, block_k)
+    acc = lax.fori_loop(lo, n_blocks, body, jnp.zeros((bq, d), jnp.float32))
     dq_ref[0] = acc.astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kv_ref,
                 dk_ref, dv_ref, *, sm_scale: float, causal: bool,
-                block_q: int, q_len: int):
+                block_q: int, q_len: int, window: int | None = None):
     k = k_ref[0]                                     # (bk, D)
     v = v_ref[0]
     bk, d = k.shape
@@ -236,7 +254,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kv_ref,
         delta = delta_ref[0, pl.ds(i * block_q, block_q), :]
         s = _dot(q, k, ((1,), (1,))) * sm_scale      # (bq, bk) f32
         if causal:
-            s = _causal_mask(s, i * block_q, k_off, block_q, bk)
+            s = _causal_mask(s, i * block_q, k_off, block_q, bk, window)
         if valid is not None:
             s = jnp.where(valid > 0, s, NEG_INF)
         p = jnp.exp(s - lse)
@@ -250,13 +268,18 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kv_ref,
     # causal: query blocks strictly above this key block's row range never
     # attend to it — start the loop at the diagonal
     lo = k_off // block_q if causal else 0
-    dk, dv = lax.fori_loop(lo, q_len // block_q, body, (zeros, zeros))
+    hi = q_len // block_q
+    if causal and window is not None:
+        # windowed: queries beyond k_pos + window - 1 never attend either
+        hi = jnp.minimum(hi,
+                         (k_off + bk + window - 2) // block_q + 1)
+    dk, dv = lax.fori_loop(lo, hi, body, (zeros, zeros))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _flash_bwd(q, k, v, kvalid, out, lse, g, sm_scale, causal, block_q,
-               block_k, interpret):
+               block_k, interpret, window=None):
     BH, Tq, D = q.shape
     Tk = k.shape[1]
     block_q = _fit_block(Tq, block_q)
@@ -285,7 +308,8 @@ def _flash_bwd(q, k, v, kvalid, out, lse, g, sm_scale, causal, block_q,
     # ---- dQ: grid over query blocks -------------------------------------
     dq_kernel = functools.partial(
         _dq_kernel if kvalid is not None else drop_kv(_dq_kernel, 6),
-        sm_scale=sm_scale, causal=causal, block_k=block_k, k_len=Tk)
+        sm_scale=sm_scale, causal=causal, block_k=block_k, k_len=Tk,
+        window=window)
     dq_specs = [qspec, kfull, kfull, qspec, lseblk, lseblk]
     dq_args = [q, k, v, g, lse, delta]
     if kvalid is not None:
@@ -303,7 +327,8 @@ def _flash_bwd(q, k, v, kvalid, out, lse, g, sm_scale, causal, block_q,
     # ---- dK/dV (fused): grid over key blocks ----------------------------
     dkv_kernel = functools.partial(
         _dkv_kernel if kvalid is not None else drop_kv(_dkv_kernel, 6),
-        sm_scale=sm_scale, causal=causal, block_q=block_q, q_len=Tq)
+        sm_scale=sm_scale, causal=causal, block_q=block_q, q_len=Tq,
+        window=window)
     dkv_specs = [qfull, kspec, kspec, qfull, lsefull, lsefull]
     dkv_args = [q, k, v, g, lse, delta]
     if kvalid is not None:
@@ -325,25 +350,26 @@ def _flash_bwd(q, k, v, kvalid, out, lse, g, sm_scale, causal, block_q,
 # custom_vjp plumbing + public API
 # --------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
 def _flash_bhtd(q, k, v, kvalid, sm_scale, causal, block_q, block_k,
-                interpret):
+                interpret, window):
     out, _ = _flash_fwd(q, k, v, kvalid, sm_scale, causal, block_q, block_k,
-                        interpret)
+                        interpret, window)
     return out
 
 
 def _flash_vjp_fwd(q, k, v, kvalid, sm_scale, causal, block_q, block_k,
-                   interpret):
+                   interpret, window):
     out, lse = _flash_fwd(q, k, v, kvalid, sm_scale, causal, block_q,
-                          block_k, interpret)
+                          block_k, interpret, window)
     return out, (q, k, v, kvalid, out, lse)
 
 
-def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, window,
+                   res, g):
     q, k, v, kvalid, out, lse = res
     dq, dk, dv = _flash_bwd(q, k, v, kvalid, out, lse, g, sm_scale, causal,
-                            block_q, block_k, interpret)
+                            block_q, block_k, interpret, window)
     dkv = None if kvalid is None else jnp.zeros_like(kvalid)
     return dq, dk, dv, dkv
 
@@ -355,6 +381,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                     causal: bool = False, key_valid: jnp.ndarray | None = None,
                     sm_scale: float | None = None,
                     block_q: int = 128, block_k: int = 128,
+                    window: int | None = None,
                     interpret: bool | None = None) -> jnp.ndarray:
     """Fused attention on ``(B, T, H, D)`` q/k/v (same layout as
     :func:`..models.transformer.dot_product_attention`).
@@ -371,6 +398,12 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if window is not None:
+        if not causal:
+            raise ValueError("window (sliding-window attention) requires "
+                             "causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     B, Tq, H, D = q.shape
@@ -387,7 +420,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         kvalid = jnp.repeat(key_valid.astype(jnp.float32), H,
                             axis=0)[:, None, :]
     out = _flash_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v), kvalid, sm_scale,
-                      causal, block_q, block_k, interpret)
+                      causal, block_q, block_k, interpret, window)
     return jnp.swapaxes(out.reshape(B, H, Tq, D), 1, 2)
 
 

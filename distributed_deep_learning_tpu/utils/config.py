@@ -146,6 +146,7 @@ class Config:
     image_size: int = 224               # decode size for --data-dir images
     stem_s2d: bool = False              # space-to-depth ResNet stem (TPU opt)
     attention: str = "auto"             # auto|dense|flash (transformer family)
+    attention_window: int | None = None  # sliding-window size (flash, causal)
     optimizer: str = "auto"             # auto|sgd|momentum|adam|adamw|...
     generate_tokens: int = 0            # gpt: sample N tokens post-train
     pos_embedding: str = "learned"      # learned | rope (gpt)
@@ -257,6 +258,11 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
                         "-w sets the decode thread count")
     p.add_argument("--image-size", type=int, default=224,
                    help="square decode size for --data-dir images")
+    p.add_argument("--window", dest="attention_window", type=int,
+                   default=None, metavar="W",
+                   help="sliding-window attention: each position attends "
+                        "its last W tokens only (flash kernel, causal "
+                        "models; O(T*W) instead of O(T^2))")
     p.add_argument("--stem-s2d", action="store_true",
                    help="space-to-depth ResNet stem: pack 2x2 input patches "
                         "into channels and run the mathematically equivalent "
@@ -371,6 +377,7 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         image_size=args.image_size,
         stem_s2d=args.stem_s2d,
         attention=args.attention,
+        attention_window=args.attention_window,
         optimizer=args.optimizer,
         generate_tokens=args.generate_tokens,
         pos_embedding=args.pos_embedding,

@@ -531,6 +531,17 @@ def run_workload(spec: WorkloadSpec, config: Config
         raise ValueError("--pos rope is implemented for the whole-model "
                          "modes (-m data/sequential); staged/pipelined gpt "
                          "trunks use learned positions")
+    if config.attention_window is not None:
+        if config.attention_window < 1:
+            raise ValueError(f"--window must be >= 1, got "
+                             f"{config.attention_window}")
+        if spec.name != "gpt":
+            raise ValueError(f"--window needs a causal decoder-only model; "
+                             f"workload {spec.name!r} has bidirectional or "
+                             "cross attention sites")
+        if config.mode in (Mode.MODEL, Mode.PIPELINE):
+            raise ValueError("--window is implemented for the whole-model "
+                             "modes (-m data/sequential)")
     try:
         dataset = spec.build_dataset(config)
         state, history = _run_workload(spec, config, devices, logger,

@@ -182,6 +182,9 @@ def _attention_fn(config: Config):
 
         return make_attention_fn()
     return None  # models fall back to dense dot_product_attention
+    # (--window rides as a MODEL attribute — CausalLM.attention_window —
+    # so the flash kernel, the dense fallback and the KV-cache decode all
+    # apply the same band; see models/transformer.py)
 
 
 def _vocab(dataset) -> int:
@@ -395,6 +398,7 @@ def _gpt_model(config: Config, dataset):
                     dropout_rate=config.dropout, with_logits=True,
                     max_len=max(dataset.features.shape[1], 8),
                     pos_embedding=config.pos_embedding,
+                    attention_window=config.attention_window,
                     dtype=config_dtype(config),
                     attention_fn=_attention_fn(config))
 

@@ -223,3 +223,61 @@ def test_causal_cross_length_backward():
         dot_product_attention(q, k, v, causal=True) ** 2))(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gd), rtol=1e-4,
                                atol=1e-5)
+
+
+def _band_mask(T, window):
+    q = np.arange(T)[:, None]
+    k = np.arange(T)[None, :]
+    return jnp.asarray((q >= k) & (q - k < window))[None, None]
+
+
+def test_sliding_window_matches_dense_band():
+    """window=W == dense attention under an explicit causal band mask."""
+    from distributed_deep_learning_tpu.models.transformer import (
+        dot_product_attention)
+
+    q, k, v = _qkv(T=32, seed=20)
+    for W in (1, 5, 8, 32, 100):
+        got = flash_attention(q, k, v, causal=True, window=W,
+                              block_q=8, block_k=8)
+        expected = dot_product_attention(q, k, v, mask=_band_mask(32, W))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   rtol=1e-5, atol=1e-5,
+                                   err_msg=f"window={W}")
+
+
+def test_sliding_window_gradients_match_dense_band():
+    from distributed_deep_learning_tpu.models.transformer import (
+        dot_product_attention)
+
+    q, k, v = _qkv(T=24, seed=21)
+    W = 7
+
+    g_flash = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, causal=True, window=W, block_q=8, block_k=8) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(lambda q, k, v: jnp.sum(dot_product_attention(
+        q, k, v, mask=_band_mask(24, W)) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for gf, gd in zip(g_flash, g_dense):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_sliding_window_requires_causal():
+    q, k, v = _qkv(T=16, seed=22)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, window=4)
+
+
+def test_sliding_window_with_padding():
+    from distributed_deep_learning_tpu.models.transformer import (
+        dot_product_attention)
+
+    q, k, v = _qkv(T=16, seed=23)
+    valid = jnp.arange(16)[None, :] < jnp.array([[12], [16]])
+    got = flash_attention(q, k, v, causal=True, window=5, key_valid=valid,
+                          block_q=8, block_k=8)
+    expected = dot_product_attention(q, k, v, key_valid=valid,
+                                     mask=_band_mask(16, 5))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=1e-5, atol=1e-5)

@@ -160,3 +160,25 @@ def test_rope_generate_runs():
     params = model.init(jax.random.key(17), prompt)["params"]
     out = generate(model, params, prompt, max_new_tokens=5)
     assert out.shape == (2, 5)
+
+
+def test_windowed_cached_decode_matches_full_forward():
+    """Train/inference parity with --window: the KV-cache decode applies
+    the same causal band as the full forward (review regression — decode
+    previously attended the whole prefix)."""
+    model = _model(with_logits=True, attention_window=4)
+    toks = jax.random.randint(jax.random.key(18), (2, 12), 1, 61)
+    params = model.init(jax.random.key(19), toks)["params"]
+    full = model.apply({"params": params}, toks)
+
+    lm = model.clone(decode=True)
+    shapes = jax.eval_shape(lm.init, jax.random.key(0), toks)
+    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                         shapes["cache"])
+    for t in range(toks.shape[1]):
+        step_logits, upd = lm.apply({"params": params, "cache": cache},
+                                    toks[:, t:t + 1], mutable=["cache"])
+        cache = upd["cache"]
+        np.testing.assert_allclose(np.asarray(step_logits[:, 0]),
+                                   np.asarray(full[:, t]),
+                                   rtol=3e-4, atol=3e-4)

@@ -225,3 +225,24 @@ def test_gpt_rope_trains():
     _, h = _run("gpt", ["-l", "1", "-s", "64", "-e", "1", "-b", "32",
                         "--pos", "rope"], limit=512)
     _ok(h)
+
+
+def test_gpt_window_attention_trains():
+    """--window W rides as a model attribute: the dense fallback and the
+    flash kernel apply the same causal band."""
+    _, h = _run("gpt", ["-l", "1", "-s", "32", "-e", "1", "-b", "16",
+                        "--window", "8"], limit=128)
+    _ok(h)
+
+
+def test_window_rejected_where_unsupported():
+    with pytest.raises(ValueError, match="--window"):
+        _run("bert", ["-l", "1", "-s", "32", "-e", "1", "-b", "16",
+                      "--window", "8"], limit=128)
+    with pytest.raises(ValueError, match="--window"):
+        _run("gpt", ["-l", "2", "-s", "32", "-e", "1", "-b", "16",
+                     "-m", "model", "--nstages", "2", "--window", "8"],
+             limit=128)
+    with pytest.raises(ValueError, match="--window"):
+        _run("gpt", ["-l", "1", "-s", "32", "-e", "1", "-b", "16",
+                     "--window", "0"], limit=128)
