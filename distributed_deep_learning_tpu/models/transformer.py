@@ -105,16 +105,23 @@ class MultiHeadAttention(nn.Module):
     decode: bool = False
     rope: bool = False
     window: Optional[int] = None   # causal sliding-window size
+    num_kv_heads: Optional[int] = None  # < num_heads = grouped-query attn
 
     @nn.compact
     def __call__(self, x_q, x_kv, key_valid=None, *, causal: bool = False,
                  mask=None):
         d_model = x_q.shape[-1]
         head_dim = d_model // self.num_heads
-        proj = lambda name: nn.DenseGeneral(  # noqa: E731
-            (self.num_heads, head_dim), dtype=self.dtype,
+        kv_heads = self.num_kv_heads or self.num_heads
+        if self.num_heads % kv_heads:
+            raise ValueError(f"num_kv_heads {kv_heads} must divide "
+                             f"num_heads {self.num_heads}")
+        proj = lambda name, h: nn.DenseGeneral(  # noqa: E731
+            (h, head_dim), dtype=self.dtype,
             kernel_init=dense_init, name=name)
-        q, k, v = proj("q")(x_q), proj("k")(x_kv), proj("v")(x_kv)
+        q = proj("q", self.num_heads)(x_q)
+        k = proj("k", kv_heads)(x_kv)
+        v = proj("v", kv_heads)(x_kv)
         if self.rope:
             start = jnp.zeros((), jnp.int32)
             if self.decode and self.has_variable("cache", "cache_index"):
@@ -164,6 +171,13 @@ class MultiHeadAttention(nn.Module):
                 idx.value = idx.value + T
                 causal = False
                 attn = dot_product_attention  # fused kernels reject masks
+        if kv_heads != self.num_heads:
+            # GQA: K/V carry kv_heads (and the KV cache stores only those
+            # — the H/kv_heads memory win); expand to full heads for the
+            # attention contraction (XLA fuses the broadcast)
+            group = self.num_heads // kv_heads
+            k = jnp.repeat(k, group, axis=2)
+            v = jnp.repeat(v, group, axis=2)
         kw = {}
         if self.window is not None and mask is None:
             # structured convention: window rides alongside causal so the
@@ -193,6 +207,7 @@ class TransformerLayer(nn.Module):
     decode: bool = False
     rope: bool = False
     window: Optional[int] = None
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, encoded=None, *, self_valid=None, cross_valid=None,
@@ -201,6 +216,7 @@ class TransformerLayer(nn.Module):
         h = MultiHeadAttention(self.num_heads, self.dtype, self.attention_fn,
                                decode=self.decode, rope=self.rope,
                                window=self.window,
+                               num_kv_heads=self.num_kv_heads,
                                name="self_attn")(h, h, self_valid,
                                                  causal=self.causal)
         h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
@@ -340,6 +356,7 @@ class CausalLM(nn.Module):
     decode: bool = False        # KV-cached autoregressive decode mode
     pos_embedding: str = "learned"   # learned | rope
     attention_window: Optional[int] = None  # causal sliding window
+    num_kv_heads: Optional[int] = None      # grouped-query attention
     dtype: jnp.dtype = jnp.float32
     attention_fn: Optional[AttentionFn] = None
 
@@ -357,6 +374,7 @@ class CausalLM(nn.Module):
                                  attention_fn=self.attention_fn,
                                  decode=self.decode, rope=rope,
                                  window=self.attention_window,
+                                 num_kv_heads=self.num_kv_heads,
                                  name=f"layer_{i}")(x, self_valid=valid,
                                                     train=train)
         x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)

@@ -150,6 +150,7 @@ class Config:
     optimizer: str = "auto"             # auto|sgd|momentum|adam|adamw|...
     generate_tokens: int = 0            # gpt: sample N tokens post-train
     pos_embedding: str = "learned"      # learned | rope (gpt)
+    num_kv_heads: int | None = None     # grouped-query attention (gpt)
     pipeline_schedule: str = "gpipe"    # gpipe | 1f1b | interleaved
     virtual_stages: int = 2             # chunks/device (interleaved)
     lr_schedule: str = "none"           # none|cosine|rsqrt|step (north stars)
@@ -283,6 +284,11 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
                         "layerwise-adaptive large-batch; auto keeps the "
                         "per-workload recipe (sgd+momentum for vision, "
                         "adamw for LMs)")
+    p.add_argument("--kv-heads", dest="num_kv_heads", type=int,
+                   default=None, metavar="K",
+                   help="gpt grouped-query attention: K key/value heads "
+                        "shared by the query heads (must divide them; "
+                        "shrinks the KV cache by heads/K)")
     p.add_argument("--pos", dest="pos_embedding",
                    choices=["learned", "rope"], default="learned",
                    help="gpt position encoding: learned absolute table or "
@@ -381,6 +387,7 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         optimizer=args.optimizer,
         generate_tokens=args.generate_tokens,
         pos_embedding=args.pos_embedding,
+        num_kv_heads=args.num_kv_heads,
         pipeline_schedule=args.pipeline_schedule,
         virtual_stages=args.virtual_stages,
         lr_schedule=args.lr_schedule,

@@ -542,6 +542,17 @@ def run_workload(spec: WorkloadSpec, config: Config
         if config.mode in (Mode.MODEL, Mode.PIPELINE):
             raise ValueError("--window is implemented for the whole-model "
                              "modes (-m data/sequential)")
+    if config.num_kv_heads is not None:
+        if config.num_kv_heads < 1:
+            raise ValueError(f"--kv-heads must be >= 1, got "
+                             f"{config.num_kv_heads}")
+        if spec.name != "gpt":
+            raise ValueError("--kv-heads (grouped-query attention) is a "
+                             f"gpt option; workload {spec.name!r} models "
+                             "define their own head layout")
+        if config.mode in (Mode.MODEL, Mode.PIPELINE):
+            raise ValueError("--kv-heads is implemented for the "
+                             "whole-model modes (-m data/sequential)")
     try:
         dataset = spec.build_dataset(config)
         state, history = _run_workload(spec, config, devices, logger,

@@ -399,6 +399,7 @@ def _gpt_model(config: Config, dataset):
                     max_len=max(dataset.features.shape[1], 8),
                     pos_embedding=config.pos_embedding,
                     attention_window=config.attention_window,
+                    num_kv_heads=config.num_kv_heads,
                     dtype=config_dtype(config),
                     attention_fn=_attention_fn(config))
 

@@ -182,3 +182,48 @@ def test_windowed_cached_decode_matches_full_forward():
         np.testing.assert_allclose(np.asarray(step_logits[:, 0]),
                                    np.asarray(full[:, t]),
                                    rtol=3e-4, atol=3e-4)
+
+
+def test_gqa_matches_mha_when_equal_heads():
+    """num_kv_heads == num_heads must be numerically identical to MHA
+    (same parameter shapes, same math)."""
+    m1 = _model(with_logits=True)
+    m2 = _model(with_logits=True, num_kv_heads=4)  # == num_heads
+    toks = jax.random.randint(jax.random.key(20), (2, 8), 1, 61)
+    p1 = m1.init(jax.random.key(21), toks)["params"]
+    np.testing.assert_allclose(
+        np.asarray(m1.apply({"params": p1}, toks)),
+        np.asarray(m2.apply({"params": p1}, toks)), rtol=1e-6)
+
+
+def test_gqa_cache_is_small_and_decode_matches_full():
+    """GQA: the KV cache stores num_kv_heads (the memory win), and cached
+    decode still matches the full forward exactly."""
+    model = _model(with_logits=True, num_kv_heads=2)  # 4 q heads, 2 kv
+    toks = jax.random.randint(jax.random.key(22), (2, 10), 1, 61)
+    params = model.init(jax.random.key(23), toks)["params"]
+    assert params["layer_0"]["self_attn"]["k"]["kernel"].shape[-2] == 2
+    full = model.apply({"params": params}, toks)
+
+    lm = model.clone(decode=True)
+    shapes = jax.eval_shape(lm.init, jax.random.key(0), toks)
+    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                         shapes["cache"])
+    ck = cache["layer_0"]["self_attn"]["cached_key"]
+    assert ck.shape[-2] == 2, f"cache stores kv heads, got {ck.shape}"
+    for t in range(toks.shape[1]):
+        step_logits, upd = lm.apply({"params": params, "cache": cache},
+                                    toks[:, t:t + 1], mutable=["cache"])
+        cache = upd["cache"]
+        np.testing.assert_allclose(np.asarray(step_logits[:, 0]),
+                                   np.asarray(full[:, t]),
+                                   rtol=3e-4, atol=3e-4)
+
+
+def test_gqa_indivisible_heads_rejected():
+    import pytest
+
+    model = _model(with_logits=True, num_kv_heads=3)  # 4 % 3 != 0
+    toks = jnp.ones((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="divide"):
+        model.init(jax.random.key(0), toks)

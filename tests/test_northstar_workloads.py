@@ -246,3 +246,18 @@ def test_window_rejected_where_unsupported():
     with pytest.raises(ValueError, match="--window"):
         _run("gpt", ["-l", "1", "-s", "32", "-e", "1", "-b", "16",
                      "--window", "0"], limit=128)
+
+
+def test_gpt_gqa_trains_and_rejected_elsewhere():
+    _, h = _run("gpt", ["-l", "1", "-s", "64", "-e", "1", "-b", "16",
+                        "--kv-heads", "1"], limit=128)
+    _ok(h)
+    with pytest.raises(ValueError, match="--kv-heads"):
+        _run("bert", ["-l", "1", "-s", "32", "-e", "1", "-b", "16",
+                      "--kv-heads", "2"], limit=128)
+
+
+def test_kv_heads_zero_rejected():
+    with pytest.raises(ValueError, match="--kv-heads"):
+        _run("gpt", ["-l", "1", "-s", "32", "-e", "1", "-b", "16",
+                     "--kv-heads", "0"], limit=128)
