@@ -14,6 +14,7 @@
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import optax
 
@@ -39,15 +40,26 @@ def l1_loss(pred: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
     return jnp.mean(jnp.abs(pred - targets))
 
 
-def token_cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray
-                        ) -> jnp.ndarray:
+def token_cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray,
+                        label_smoothing: float = 0.0) -> jnp.ndarray:
     """Mean CE over non-pad token positions: ``logits`` (..., T, V) vs
     integer ids ``targets`` (..., T) where id 0 is pad/ignored — the loss
     convention for the seq2seq and MLM north-star workloads (matching
-    :func:`prediction_metrics`' pad exclusion)."""
+    :func:`prediction_metrics`' pad exclusion).
+
+    ``label_smoothing`` ε spreads (1−ε) on the target id and ε/V on the
+    rest (the transformer-base recipe, ε = 0.1 in the paper)."""
     valid = (targets != 0).astype(jnp.float32)
-    per_tok = optax.softmax_cross_entropy_with_integer_labels(
-        logits, jnp.maximum(targets, 0))
+    tgt = jnp.maximum(targets, 0)
+    if label_smoothing:
+        V = logits.shape[-1]
+        eps = label_smoothing
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        picked = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        per_tok = -(1.0 - eps) * picked - (eps / V) * jnp.sum(logp, axis=-1)
+    else:
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(logits,
+                                                                  tgt)
     return jnp.sum(per_tok * valid) / jnp.maximum(jnp.sum(valid), 1.0)
 
 

@@ -151,6 +151,7 @@ class Config:
     generate_tokens: int = 0            # gpt: sample N tokens post-train
     pos_embedding: str = "learned"      # learned | rope (gpt)
     num_kv_heads: int | None = None     # grouped-query attention (gpt)
+    label_smoothing: float = 0.0        # token-CE smoothing (LM families)
     pipeline_schedule: str = "gpipe"    # gpipe | 1f1b | interleaved
     virtual_stages: int = 2             # chunks/device (interleaved)
     lr_schedule: str = "none"           # none|cosine|rsqrt|step (north stars)
@@ -284,6 +285,11 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
                         "layerwise-adaptive large-batch; auto keeps the "
                         "per-workload recipe (sgd+momentum for vision, "
                         "adamw for LMs)")
+    p.add_argument("--label-smoothing", type=float, default=0.0,
+                   metavar="EPS",
+                   help="label smoothing for the token cross-entropy "
+                        "(transformer/bert/moe/gpt; 0.1 = the "
+                        "transformer-base recipe)")
     p.add_argument("--kv-heads", dest="num_kv_heads", type=int,
                    default=None, metavar="K",
                    help="gpt grouped-query attention: K key/value heads "
@@ -388,6 +394,7 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         generate_tokens=args.generate_tokens,
         pos_embedding=args.pos_embedding,
         num_kv_heads=args.num_kv_heads,
+        label_smoothing=args.label_smoothing,
         pipeline_schedule=args.pipeline_schedule,
         virtual_stages=args.virtual_stages,
         lr_schedule=args.lr_schedule,

@@ -542,6 +542,14 @@ def run_workload(spec: WorkloadSpec, config: Config
         if config.mode in (Mode.MODEL, Mode.PIPELINE):
             raise ValueError("--window is implemented for the whole-model "
                              "modes (-m data/sequential)")
+    if config.label_smoothing:
+        if not 0.0 < config.label_smoothing < 1.0:
+            raise ValueError(f"--label-smoothing must be in (0, 1), got "
+                             f"{config.label_smoothing}")
+        if spec.name not in ("transformer", "bert", "moe", "gpt"):
+            raise ValueError("--label-smoothing applies to the token-CE "
+                             f"workloads (transformer/bert/moe/gpt), not "
+                             f"{spec.name!r}")
     if config.num_kv_heads is not None:
         if config.num_kv_heads < 1:
             raise ValueError(f"--kv-heads must be >= 1, got "

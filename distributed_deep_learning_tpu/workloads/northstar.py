@@ -116,6 +116,14 @@ RESNET_SPEC = WorkloadSpec(
 
 
 
+def _token_ce_loss(c: Config):
+    """Per-config token cross-entropy (single definition for the four LM
+    specs — --label-smoothing rides through here)."""
+    from functools import partial
+
+    return partial(token_cross_entropy, label_smoothing=c.label_smoothing)
+
+
 def _n_chunks(config: Config) -> int:
     """Chunks per device for the interleaved pipeline schedule (1 = plain
     stacking for gpipe/1f1b)."""
@@ -243,7 +251,7 @@ TRANSFORMER_SPEC = WorkloadSpec(
     build_model=_transformer_model,
     build_layers=_transformer_layers,
     partitioner=balanced_partition,
-    build_loss=lambda c: token_cross_entropy,
+    build_loss=_token_ce_loss,
     build_optimizer=lambda c, steps: adamw(
         resolve_lr(c, steps, c.learning_rate)),
     example_input=lambda c, ds: jnp.zeros((1, ds.features.shape[1]),
@@ -320,7 +328,7 @@ BERT_SPEC = WorkloadSpec(
     build_model=_bert_model,
     build_layers=_bert_layers,
     partitioner=balanced_partition,
-    build_loss=lambda c: token_cross_entropy,
+    build_loss=_token_ce_loss,
     build_optimizer=lambda c, steps: adamw(
         resolve_lr(c, steps, c.learning_rate)),
     example_input=lambda c, ds: jnp.zeros((1, ds.features.shape[1]),
@@ -364,7 +372,7 @@ MOE_SPEC = WorkloadSpec(
     build_model=_moe_model,
     build_layers=_moe_no_staging,
     partitioner=lambda n, s: np.zeros(n, np.int64),
-    build_loss=lambda c: token_cross_entropy,
+    build_loss=_token_ce_loss,
     build_optimizer=lambda c, steps: adamw(
         resolve_lr(c, steps, c.learning_rate)),
     example_input=lambda c, ds: jnp.zeros((1, ds.features.shape[1]),
@@ -467,7 +475,7 @@ GPT_SPEC = WorkloadSpec(
     build_model=_gpt_model,
     build_layers=_gpt_layers,
     partitioner=balanced_partition,
-    build_loss=lambda c: token_cross_entropy,
+    build_loss=_token_ce_loss,
     build_optimizer=lambda c, steps: adamw(
         resolve_lr(c, steps, c.learning_rate)),
     example_input=lambda c, ds: jnp.zeros((1, ds.features.shape[1]),

@@ -261,3 +261,37 @@ def test_kv_heads_zero_rejected():
     with pytest.raises(ValueError, match="--kv-heads"):
         _run("gpt", ["-l", "1", "-s", "32", "-e", "1", "-b", "16",
                      "--kv-heads", "0"], limit=128)
+
+
+def test_label_smoothing():
+    """--label-smoothing: eps=0 matches plain CE; eps>0 trains and raises
+    the optimum loss floor (cannot reach 0)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_deep_learning_tpu.train.objectives import (
+        token_cross_entropy)
+
+    logits = jax.random.normal(jax.random.key(0), (2, 6, 11))
+    targets = jax.random.randint(jax.random.key(1), (2, 6), 1, 11)
+    np.testing.assert_allclose(
+        float(token_cross_entropy(logits, targets, label_smoothing=0.0)),
+        float(token_cross_entropy(logits, targets)), rtol=1e-6)
+    # perfect logits: smoothed loss stays above zero, unsmoothed goes to ~0
+    perfect = 50.0 * jax.nn.one_hot(targets, 11)
+    assert float(token_cross_entropy(perfect, targets)) < 1e-3
+    assert float(token_cross_entropy(perfect, targets,
+                                     label_smoothing=0.1)) > 0.5
+
+    _, h = _run("gpt", ["-l", "1", "-s", "32", "-e", "1", "-b", "16",
+                        "--label-smoothing", "0.1"], limit=128)
+    _ok(h)
+
+
+def test_label_smoothing_validated():
+    with pytest.raises(ValueError, match="--label-smoothing"):
+        _run("gpt", ["-l", "1", "-s", "32", "-e", "1", "-b", "16",
+                     "--label-smoothing", "1.5"], limit=128)
+    with pytest.raises(ValueError, match="--label-smoothing"):
+        _run("resnet", ["-s", "18", "-e", "1", "-b", "16",
+                        "--label-smoothing", "0.1"], limit=128)
