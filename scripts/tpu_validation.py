@@ -1,0 +1,86 @@
+"""One-shot TPU validation batch for the round-3 perf work.
+
+Run on a healthy TPU window: times flash-vs-dense attention (fwd+bwd,
+long context), the s2d-vs-plain ResNet stem, and prints the full bench
+line. Each section is independently guarded — partial hardware windows
+still yield partial numbers. Results print as one JSON object per line
+for easy collection into PERFORMANCE.md.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def _sync(x):
+    import jax.numpy as jnp
+
+    return float(jnp.sum(x.astype(jnp.float32)))
+
+
+def flash_vs_dense(B=4, T=2048, H=8, D=64, steps=20):
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_deep_learning_tpu.models.transformer import (
+        dot_product_attention)
+    from distributed_deep_learning_tpu.ops.attention_pallas import (
+        flash_attention)
+
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.bfloat16)
+               for kk in ks)
+
+    def bench(fn):
+        loss = jax.jit(jax.grad(lambda q: jnp.sum(fn(q, k, v) ** 2)))
+        _sync(loss(q))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            g = loss(q)
+        _sync(g)
+        return (time.perf_counter() - t0) / steps
+
+    td = bench(lambda q, k, v: dot_product_attention(
+        q, k, v, causal=True, dtype=jnp.bfloat16))
+    tf = bench(lambda q, k, v: flash_attention(
+        q, k, v, causal=True).astype(jnp.bfloat16))
+    tw = bench(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, window=512).astype(jnp.bfloat16))
+    return {"section": "flash_vs_dense", "T": T,
+            "dense_ms": round(td * 1e3, 3), "flash_ms": round(tf * 1e3, 3),
+            "windowed512_ms": round(tw * 1e3, 3),
+            "speedup": round(td / tf, 3)}
+
+
+def s2d_vs_plain(batch=128, steps=10):
+    import jax
+
+    from distributed_deep_learning_tpu.runtime.mesh import build_mesh
+    from bench import _train_throughput
+    from distributed_deep_learning_tpu.models.resnet import resnet50
+    import jax.numpy as jnp
+
+    mesh = build_mesh({"data": len(jax.devices())})
+    ips_plain, _ = _train_throughput(
+        resnet50(dtype=jnp.bfloat16), image_size=224, num_classes=1000,
+        batch=batch, steps=steps, mesh=mesh)
+    ips_s2d, _ = _train_throughput(
+        resnet50(dtype=jnp.bfloat16, stem_s2d=True), image_size=224,
+        num_classes=1000, batch=batch, steps=steps, mesh=mesh)
+    return {"section": "s2d_stem", "batch": batch,
+            "plain_ips": round(ips_plain, 1), "s2d_ips": round(ips_s2d, 1),
+            "speedup": round(ips_s2d / ips_plain, 4)}
+
+
+def main():
+    for fn in (flash_vs_dense, s2d_vs_plain):
+        try:
+            print(json.dumps(fn()))
+        except Exception as exc:  # partial windows yield partial numbers
+            print(json.dumps({"section": fn.__name__,
+                              "error": f"{type(exc).__name__}: {exc}"}))
+
+
+if __name__ == "__main__":
+    main()
