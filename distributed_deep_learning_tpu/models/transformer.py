@@ -443,6 +443,7 @@ def bert_base(**kw) -> BertEncoder:
 
 def generate(model: "CausalLM", params, prompt: jnp.ndarray, *,
              max_new_tokens: int, temperature: float = 0.0,
+             top_k: int | None = None,
              rng: jnp.ndarray | None = None) -> jnp.ndarray:
     """KV-cached autoregressive generation from a trained :class:`CausalLM`.
 
@@ -483,8 +484,15 @@ def generate(model: "CausalLM", params, prompt: jnp.ndarray, *,
                          shapes["cache"])
     key0 = rng if rng is not None else jax.random.key(0)
 
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+
     def pick(hidden_last, key):
         nl = model.logits_from({"params": params}, hidden_last)  # (B, V)
+        if top_k is not None and top_k < nl.shape[-1]:
+            # mask everything below the k-th logit (static k — jit-safe)
+            kth = jnp.sort(nl, axis=-1)[:, -top_k][:, None]
+            nl = jnp.where(nl >= kth, nl, -jnp.inf)
         if temperature == 0.0:
             return jnp.argmax(nl, axis=-1), key
         key, sub = jax.random.split(key)

@@ -227,3 +227,25 @@ def test_gqa_indivisible_heads_rejected():
     toks = jnp.ones((1, 4), jnp.int32)
     with pytest.raises(ValueError, match="divide"):
         model.init(jax.random.key(0), toks)
+
+
+def test_top_k_sampling():
+    """top_k=1 with temperature reproduces greedy; top_k restricts the
+    sampled support; top_k < 1 is rejected."""
+    import pytest
+
+    model = _model(with_logits=True)
+    prompt = jax.random.randint(jax.random.key(24), (2, 4), 1, 61)
+    params = model.init(jax.random.key(25), prompt)["params"]
+
+    greedy = generate(model, params, prompt, max_new_tokens=5)
+    k1 = generate(model, params, prompt, max_new_tokens=5,
+                  temperature=1.0, top_k=1, rng=jax.random.key(26))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+    out = generate(model, params, prompt, max_new_tokens=5,
+                   temperature=2.0, top_k=5, rng=jax.random.key(27))
+    assert out.shape == (2, 5)
+
+    with pytest.raises(ValueError, match="top_k"):
+        generate(model, params, prompt, max_new_tokens=2, top_k=0)
