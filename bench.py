@@ -198,6 +198,138 @@ def _lm_throughput(*, batch, seq_len, steps, mesh, dtype):
     return batch * seq_len * steps / dt / n_chips, flops_per_step
 
 
+def _input_pipeline(*, mesh, dtype) -> dict | None:
+    """End-to-end train throughput THROUGH the host input pipeline
+    (VERDICT r4 item: the reference's data layer was its known bottleneck,
+    ``CNN/dataset.py:90-107`` per-item ``.to(device)``; this repo fixed the
+    design — batch-level gather + one sharded device_put + thread
+    prefetch — and this section measures it instead of asserting it).
+
+    Times the SAME DenseNet train step three ways: preloaded
+    device-resident tensors (compute floor), a synthetic in-memory
+    ArrayDataset through DeviceLoader+PrefetchLoader, and an
+    ImageFolderDataset over freshly generated JPEG files (PIL decode +
+    native C++ resize on the measured path).  ``stall_fraction`` =
+    1 - preloaded_time/loader_time (0 = input fully hidden).
+    """
+    import tempfile
+
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_deep_learning_tpu.data.datasets import synthetic_pcb
+    from distributed_deep_learning_tpu.data.loader import (BATCH_AXES,
+                                                           DeviceLoader,
+                                                           PrefetchLoader)
+    from distributed_deep_learning_tpu.train.objectives import (
+        cross_entropy_loss)
+    from distributed_deep_learning_tpu.train.state import create_train_state
+    from distributed_deep_learning_tpu.train.step import (make_step_fns,
+                                                          place_state)
+    from __graft_entry__ import _flagship
+    import jax.numpy as jnp
+
+    n_chips = len(mesh.devices.flatten())
+    on_tpu = mesh.devices.flatten()[0].platform == "tpu"
+    batch = int(os.environ.get("BENCH_INPUT_BATCH",
+                               256 * n_chips if on_tpu else 8))
+    steps = int(os.environ.get("BENCH_INPUT_STEPS", 12 if on_tpu else 2))
+    n_rows = max(2 * batch, 512)
+
+    ds = synthetic_pcb(n=n_rows)
+    model = _flagship(dtype=dtype)
+    state = create_train_state(model, jax.random.key(0),
+                               jnp.ones((1, 64, 64, 3)),
+                               optax.sgd(0.01, momentum=0.9))
+    state = place_state(state, mesh)
+    train_step, _ = make_step_fns(mesh, cross_entropy_loss)
+    sh = NamedSharding(mesh, P(BATCH_AXES))
+
+    def run_epochs(loader, n_steps):
+        """Drive ``n_steps`` train steps from ``loader``, cycling epochs;
+        returns seconds/step (host fetch at the end = device barrier)."""
+        nonlocal state
+        it, done = iter(loader), 0
+        # warmup one batch (compile with these shapes)
+        x, y = next(it)
+        state, m = train_step(state, x, y)
+        float(m["loss"])
+        t0 = time.perf_counter()
+        while done < n_steps:
+            try:
+                x, y = next(it)
+            except StopIteration:
+                it = iter(loader)
+                continue
+            state, m = train_step(state, x, y)
+            done += 1
+        float(m["loss"])
+        return (time.perf_counter() - t0) / n_steps
+
+    # --- floor: preloaded device tensors --------------------------------
+    rng = np.random.default_rng(3)
+    xh = rng.standard_normal((batch, 64, 64, 3), dtype=np.float32)
+    yh = np.eye(6, dtype=np.float32)[rng.integers(0, 6, batch)]
+    xd, yd = jax.device_put(xh, sh), jax.device_put(yh, sh)
+    state, m = train_step(state, xd, yd)
+    float(m["loss"])  # warm
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = train_step(state, xd, yd)
+    float(m["loss"])
+    t_pre = (time.perf_counter() - t0) / steps
+
+    out: dict = {"batch": batch,
+                 "preloaded_images_per_sec_per_chip":
+                     round(batch / t_pre / n_chips, 2)}
+
+    # --- synthetic twin through DeviceLoader + prefetch -----------------
+    loader = PrefetchLoader(DeviceLoader(ds, np.arange(n_rows), batch, mesh,
+                                         shuffle=True), depth=2)
+    t_syn = run_epochs(loader, steps)
+    out["synthetic"] = {
+        "images_per_sec_per_chip": round(batch / t_syn / n_chips, 2),
+        "stall_fraction": round(max(0.0, 1 - t_pre / t_syn), 4)}
+
+    # --- ImageFolder over generated JPEGs (decode + resize measured) ----
+    try:
+        from PIL import Image
+
+        from distributed_deep_learning_tpu.data.imagefolder import (
+            ImageFolderDataset)
+
+        with tempfile.TemporaryDirectory() as root:
+            # enough files for at least one full batch (6 classes)
+            per = max(85, -(-batch // 6))
+            r2 = np.random.default_rng(4)
+            for c in range(6):
+                d = os.path.join(root, f"class{c}")
+                os.makedirs(d)
+                for i in range(per):
+                    arr = r2.integers(0, 255, (72, 72, 3), dtype=np.uint8)
+                    Image.fromarray(arr).save(
+                        os.path.join(d, f"im{i}.jpg"))
+            ifds = ImageFolderDataset(root, image_size=64,
+                                      max_cached_images=1)
+            n_use = (len(ifds) // batch) * batch
+            if n_use:
+                il = PrefetchLoader(
+                    DeviceLoader(ifds, np.arange(n_use), batch, mesh,
+                                 shuffle=True), depth=2)
+                t_img = run_epochs(il, steps)
+                out["imagefolder"] = {
+                    "images_per_sec_per_chip":
+                        round(batch / t_img / n_chips, 2),
+                    "stall_fraction":
+                        round(max(0.0, 1 - t_pre / t_img), 4)}
+    except Exception as exc:
+        print(f"bench: imagefolder input section failed "
+              f"({type(exc).__name__}: {exc})", file=sys.stderr)
+    return out
+
+
 def _attention_speedup(steps: int = 20) -> float | None:
     """Fused (Pallas flash) vs dense attention fwd+bwd at a long-context
     shape; returns flash/dense step-time ratio > 1 = flash faster.  TPU
@@ -231,6 +363,16 @@ def _attention_speedup(steps: int = 20) -> float | None:
         return t_dense / t_flash
     except Exception:
         return None
+
+
+def _time_left() -> float:
+    """Seconds until the orchestrator's soft deadline (inf when unset).
+
+    Optional sections consult this so the headline line always prints
+    inside the watchdog window — shedding the DenseNet/LM/attention
+    extras beats the whole attempt being killed mid-compile."""
+    dl = os.environ.get("BENCH_DEADLINE")
+    return float("inf") if not dl else float(dl) - time.time()
 
 
 def _vs_baseline(baselines: dict, key: str, value: float,
@@ -310,7 +452,10 @@ def main() -> None:
     # degraded transport slows it down (their absence reads as null).
     # --- secondary: the reference's flagship (DenseNet-BC, PCB 64x64) ------
     secondary = None
-    if os.environ.get("BENCH_SECONDARY", "1") != "0":
+    if os.environ.get("BENCH_SECONDARY", "1") != "0" and _time_left() < 120:
+        print(f"bench: shedding densenet section ({_time_left():.0f}s left)",
+              file=sys.stderr)
+    elif os.environ.get("BENCH_SECONDARY", "1") != "0":
         try:
             dbatch = int(os.environ.get("BENCH_DENSENET_BATCH",
                                         1024 * n_chips if on_tpu else 16))
@@ -331,7 +476,11 @@ def main() -> None:
 
     # --- LM: decoder-only transformer, flash attention + fused CE head -----
     lm = None
-    if os.environ.get("BENCH_LM", "1" if on_tpu else "0") != "0":
+    if os.environ.get("BENCH_LM", "1" if on_tpu else "0") != "0" and \
+            _time_left() < 180:
+        print(f"bench: shedding lm section ({_time_left():.0f}s left)",
+              file=sys.stderr)
+    elif os.environ.get("BENCH_LM", "1" if on_tpu else "0") != "0":
         try:
             lbatch = int(os.environ.get("BENCH_LM_BATCH",
                                         8 * n_chips if on_tpu else 2))
@@ -355,9 +504,32 @@ def main() -> None:
             print(f"bench: lm section failed "
                   f"({type(exc).__name__}: {exc})", file=sys.stderr)
 
+    # --- host input pipeline on the measured path --------------------------
+    input_pipe = None
+    if os.environ.get("BENCH_INPUT", "1") != "0" and _time_left() < 100:
+        print(f"bench: shedding input-pipeline section ({_time_left():.0f}s "
+              "left)", file=sys.stderr)
+    elif os.environ.get("BENCH_INPUT", "1") != "0":
+        try:
+            input_pipe = _input_pipeline(mesh=mesh, dtype=dtype)
+        except Exception as exc:
+            print(f"bench: input-pipeline section failed "
+                  f"({type(exc).__name__}: {exc})", file=sys.stderr)
+
     attn_speedup = None
     if on_tpu and os.environ.get("BENCH_ATTENTION", "1") != "0":
-        attn_speedup = _attention_speedup()
+        if _time_left() < 75:
+            print(f"bench: shedding attention micro ({_time_left():.0f}s "
+                  "left)", file=sys.stderr)
+        else:
+            attn_speedup = _attention_speedup()
+    if attn_speedup is not None:
+        # latest-wins decision datum: workloads' `--attention auto` gates
+        # the TPU flash default on this recorded ratio (northstar.py)
+        from distributed_deep_learning_tpu.utils.bench_records import (
+            record_flash_speedup)
+
+        record_flash_speedup(attn_speedup)
 
     print(json.dumps({
         "metric": f"resnet50_224 bf16 train images/sec/chip ({platform})",
@@ -369,67 +541,130 @@ def main() -> None:
         "device_kind": device_kind,
         "secondary": secondary,
         "lm": lm,
+        "input_pipeline": input_pipe,
         "flash_attention_speedup":
             round(attn_speedup, 3) if attn_speedup else None,
     }))
 
 
 def orchestrate() -> int:
-    """Hang-proof driver entry: run the measurement in a watchdogged
-    subprocess, stepping the per-chip batch down on timeout or failure.
+    """Deadline-proof driver entry (round-3 postmortem, VERDICT.md).
 
-    A degraded accelerator transport can make a single compile/transfer
-    block for tens of minutes with no exception to catch (observed on the
-    tunneled backend); only a process-level timeout recovers from that.
-    The last attempt forces the CPU platform so ONE JSON line always
-    prints.
+    Round 3 lost its only hardware datum because the orchestrator treated
+    fast *errors* differently from hangs: a TPU transport erroring
+    UNAVAILABLE in ~1 min per attempt walked the whole 5-attempt ladder
+    and the driver's outer timeout (rc 124) killed the process before the
+    guaranteed-CPU attempt ran.  Three rules now make "one JSON line
+    always prints" hold against a real outer budget:
+
+    1. GLOBAL wall-clock deadline (``BENCH_TIMEOUT``, default 600 s —
+       deliberately far under any plausible driver window).  Per-attempt
+       timeouts are carved from what remains, always reserving enough for
+       the CPU attempt.
+    2. ANY failed attempt — nonzero rc or timeout — counts as transport
+       evidence; after 2 failures of any kind, go straight to CPU.
+    3. A ~75 s watchdogged trivial-matmul probe precedes the first heavy
+       attempt; a hung or erroring backend is detected for the price of
+       one import instead of one ResNet compile.
+
+    Workers receive the absolute deadline (``BENCH_DEADLINE``) and shed
+    optional sections (DenseNet / LM / attention micro) to get the
+    headline out inside it.
     """
     import subprocess
+    import time as _time
 
-    # generous first-attempt budget: the worker now compiles up to three
-    # models (ResNet-50, DenseNet, CausalLM) before its line prints
-    base = float(os.environ.get("BENCH_TIMEOUT", 2400))
-    pinned = "BENCH_BATCH" in os.environ or \
-        "BENCH_BATCH_PER_CHIP" in os.environ
-    cpu_attempt = ({"JAX_PLATFORMS": "cpu", "BENCH_CPU_FALLBACK": "1"},
-                   base * 0.4)
-    attempts: list[tuple[dict, float]] = [({}, base)] if pinned else [
-        ({"BENCH_BATCH_PER_CHIP": "256"}, base),
-        ({"BENCH_BATCH_PER_CHIP": "128"}, base * 0.4),
-        ({"BENCH_BATCH_PER_CHIP": "64"}, base * 0.3),
-        # insurance against a TPU-specific s2d-stem compile failure: one
-        # attempt with the plain 7x7 stem before giving up the chip
-        ({"BENCH_BATCH_PER_CHIP": "128", "BENCH_S2D": "0"}, base * 0.4),
-    ]
-    attempts.append(cpu_attempt)
-    timeouts = 0
-    for extra, timeout in attempts:
-        if timeouts >= 2 and extra is not cpu_attempt[0]:
-            continue  # transport is hung, not OOM: go straight to CPU
+    t0 = _time.monotonic()
+    total = float(os.environ.get("BENCH_TIMEOUT", 600))
+    deadline = t0 + total
+    cpu_reserve = min(300.0, total * 0.5)
+
+    def remaining() -> float:
+        return deadline - _time.monotonic()
+
+    def run_attempt(extra: dict, timeout: float) -> str | None:
         env = dict(os.environ, BENCH_WORKER="1", **extra)
-        if extra is cpu_attempt[0]:
+        if extra.get("BENCH_CPU_FALLBACK") == "1":
             # the guaranteed-to-print attempt must not inherit a TPU-sized
             # user batch pin
             env.pop("BENCH_BATCH", None)
             env.pop("BENCH_BATCH_PER_CHIP", None)
+        # absolute soft deadline, with margin for the final print/flush
+        env["BENCH_DEADLINE"] = repr(_time.time() + timeout - 10.0)
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)], env=env,
                 stdout=subprocess.PIPE, text=True, timeout=timeout)
         except subprocess.TimeoutExpired:
-            timeouts += 1
-            print(f"bench: attempt {extra} timed out after {timeout:.0f}s; "
-                  "backing off", file=sys.stderr)
-            continue
+            print(f"bench: attempt {extra} timed out after {timeout:.0f}s",
+                  file=sys.stderr)
+            return None
         if proc.returncode == 0 and proc.stdout.strip():
-            sys.stdout.write(proc.stdout)
+            return proc.stdout
+        print(f"bench: attempt {extra} failed rc={proc.returncode}",
+              file=sys.stderr)
+        return None
+
+    def cpu_attempt() -> int:
+        # floor of 240 s even if the budget is spent: printing late still
+        # beats printing nothing, and the global default leaves this floor
+        # far inside any driver window
+        out = run_attempt({"JAX_PLATFORMS": "cpu", "BENCH_CPU_FALLBACK": "1"},
+                          max(remaining(), 240.0))
+        if out is None:  # pragma: no cover - CPU backend catastrophe
+            return 1
+        sys.stdout.write(out)
+        return 0
+
+    # --- probe: is the default backend alive at all? -----------------------
+    probe_budget = min(75.0, max(remaining() - cpu_reserve, 30.0))
+    probe_env = dict(os.environ, BENCH_WORKER="1", BENCH_PROBE="1")
+    try:
+        probe = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=probe_env,
+            stdout=subprocess.PIPE, text=True, timeout=probe_budget)
+        probe_ok = probe.returncode == 0 and "probe-ok" in probe.stdout
+    except subprocess.TimeoutExpired:
+        probe_ok = False
+    if not probe_ok:
+        print(f"bench: backend probe failed within {probe_budget:.0f}s; "
+              "straight to CPU", file=sys.stderr)
+        return cpu_attempt()
+
+    # --- accelerator attempts, batch backing off on failure ----------------
+    pinned = "BENCH_BATCH" in os.environ or \
+        "BENCH_BATCH_PER_CHIP" in os.environ
+    plan: list[dict] = [{}] if pinned else [
+        {"BENCH_BATCH_PER_CHIP": "256"},
+        {"BENCH_BATCH_PER_CHIP": "128"},
+        # insurance against a TPU-specific s2d-stem compile failure: one
+        # attempt with the plain 7x7 stem before giving up the chip
+        {"BENCH_BATCH_PER_CHIP": "128", "BENCH_S2D": "0"},
+    ]
+    failures = 0
+    for extra in plan:
+        budget = remaining() - cpu_reserve
+        if failures >= 2 or budget < 60:
+            break  # transport is sick or time is short: take the CPU line
+        out = run_attempt(extra, budget if pinned else min(budget, total * 0.6))
+        if out is not None:
+            sys.stdout.write(out)
             return 0
-        print(f"bench: attempt {extra} failed rc={proc.returncode}; "
-              "backing off", file=sys.stderr)
-    return 1
+        failures += 1
+    return cpu_attempt()
 
 
 if __name__ == "__main__":
+    if os.environ.get("BENCH_PROBE") == "1":
+        # minimal end-to-end device proof: init backend, one matmul, one
+        # host fetch — everything a heavy attempt needs, in miniature
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.ones((128, 128))
+        float(jnp.sum(x @ x))
+        print("probe-ok")
+        sys.exit(0)
     if os.environ.get("BENCH_WORKER") == "1" or \
             os.environ.get("BENCH_NO_WATCHDOG") == "1":
         sys.exit(main())

@@ -68,8 +68,12 @@ class PdMWindowedDataset:
 
 def load_pdm(path: str = "/data/PredictiveMaintenance/dataset.csv",
              history: int = 10,
-             instances_per_machine: int = 8759) -> PdMWindowedDataset:
-    """Load the real CSV (all-float32, last 5 columns targets)."""
+             instances_per_machine: int | None = 8759) -> PdMWindowedDataset:
+    """Load the real CSV (all-float32, last 5 columns targets).
+
+    ``instances_per_machine=None`` treats the whole file as ONE machine
+    (fixture/arbitrary CSVs); the default 8759 is the reference dataset's
+    per-machine row count (``LSTM/dataset.py``)."""
     if not os.path.exists(path):
         raise FileNotFoundError(
             f"{path} not found — use data.datasets.synthetic_pdm for the "
@@ -80,4 +84,5 @@ def load_pdm(path: str = "/data/PredictiveMaintenance/dataset.csv",
     return PdMWindowedDataset(
         np.ascontiguousarray(data[:, :-NUM_TARGETS]),
         np.ascontiguousarray(data[:, -NUM_TARGETS:]),
-        history=history, instances_per_machine=instances_per_machine)
+        history=history,
+        instances_per_machine=instances_per_machine or len(data))

@@ -44,18 +44,25 @@ from distributed_deep_learning_tpu.parallel.pipeline_transformer import (
 
 
 class LMEmbed(nn.Module):
-    """Token + learned positional embedding (ignores ``train``)."""
+    """Token + positional embedding (ignores ``train``).
+
+    ``pos_embedding='rope'`` creates NO position table — the rotation is
+    applied inside every attention block instead (mirroring
+    :class:`..models.transformer.CausalLM`'s convention)."""
 
     vocab_size: int
     d_model: int
     max_len: int = 4096
     dtype: jnp.dtype = jnp.float32
+    pos_embedding: str = "learned"      # "learned" | "rope"
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
         x = nn.Embed(self.vocab_size, self.d_model,
                      embedding_init=nn.initializers.normal(0.02),
                      dtype=self.dtype, name="tok")(tokens)
+        if self.pos_embedding == "rope":
+            return x
         pos = self.param("pos", nn.initializers.normal(0.02),
                          (self.max_len, self.d_model))
         return x + pos[None, :tokens.shape[1]].astype(self.dtype)
@@ -92,15 +99,27 @@ class PipelinedLM:
                  microbatch_size: Optional[int] = None,
                  max_len: int = 4096, dtype: jnp.dtype = jnp.float32,
                  attention_fn=None, dropout_rate: float = 0.0,
-                 n_chunks: int = 1):
-        self.embed = LMEmbed(vocab_size, d_model, max_len, dtype)
+                 n_chunks: int = 1, pos_embedding: str = "learned",
+                 attention_window: Optional[int] = None,
+                 num_kv_heads: Optional[int] = None):
+        if pos_embedding not in ("learned", "rope"):
+            raise ValueError(f"pos_embedding must be 'learned' or 'rope', "
+                             f"got {pos_embedding!r}")
+        if attention_window is not None and not causal:
+            raise ValueError("attention_window (sliding window) requires "
+                             "a causal trunk")
+        self.embed = LMEmbed(vocab_size, d_model, max_len, dtype,
+                             pos_embedding)
         self.trunk = PipelinedTrunk(num_layers, mesh, num_heads=num_heads,
                                     mlp_dim=mlp_dim, causal=causal,
                                     dtype=dtype,
                                     microbatch_size=microbatch_size,
                                     attention_fn=attention_fn,
                                     dropout_rate=dropout_rate,
-                                    n_chunks=n_chunks)
+                                    n_chunks=n_chunks,
+                                    rope=pos_embedding == "rope",
+                                    window=attention_window,
+                                    num_kv_heads=num_kv_heads)
         if n_chunks > 1:
             # (V, S, ...) stacks: chunk dim replicated, stage dim sharded
             self.shard_rules = ((r"^trunk/.*", P(None, "stage")),)

@@ -489,6 +489,10 @@ def generate(model: "CausalLM", params, prompt: jnp.ndarray, *,
 
     def pick(hidden_last, key):
         nl = model.logits_from({"params": params}, hidden_last)  # (B, V)
+        # never emit pad id 0: the cache records a generated 0 as invalid
+        # (valid = tokens != 0), silently dropping that position from all
+        # subsequent attention and skewing the continuation (ADVICE r3)
+        nl = nl.at[:, 0].set(-jnp.inf)
         if top_k is not None and top_k < nl.shape[-1]:
             # mask everything below the k-th logit (static k — jit-safe)
             kth = jnp.sort(nl, axis=-1)[:, -top_k][:, None]

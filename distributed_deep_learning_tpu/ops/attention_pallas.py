@@ -436,12 +436,15 @@ def make_attention_fn(causal: bool = False, **kw):
     forced_causal = causal
 
     def attn(q, k, v, *, mask=None, key_valid=None, causal=False,
-             dtype=jnp.float32):
+             window=None, dtype=jnp.float32):
         if mask is not None:
             raise NotImplementedError(
                 "flash_attention takes key_valid/causal, not dense mask "
                 "tensors (pad-free batches or the dense path instead)")
+        call_kw = dict(kw)
+        if window is not None:  # call-time window wins over the maker's
+            call_kw["window"] = window
         return flash_attention(q, k, v, causal=causal or forced_causal,
-                               key_valid=key_valid, **kw).astype(dtype)
+                               key_valid=key_valid, **call_kw).astype(dtype)
 
     return attn

@@ -47,6 +47,9 @@ class TrunkStage(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attention_fn: object = None
     dropout_rate: float = 0.0
+    rope: bool = False                  # rotary positions, applied in-block
+    window: int | None = None           # causal sliding-window size
+    num_kv_heads: int | None = None     # < num_heads = grouped-query attn
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -56,6 +59,8 @@ class TrunkStage(nn.Module):
                                  causal=self.causal,
                                  dtype=self.dtype,
                                  attention_fn=self.attention_fn,
+                                 rope=self.rope, window=self.window,
+                                 num_kv_heads=self.num_kv_heads,
                                  name=f"block_{i}")(x, train=train)
         return x
 
@@ -75,7 +80,9 @@ class PipelinedTrunk:
                  dtype: jnp.dtype = jnp.float32,
                  microbatch_size: Optional[int] = None,
                  attention_fn=None, dropout_rate: float = 0.0,
-                 n_chunks: int = 1):
+                 n_chunks: int = 1, rope: bool = False,
+                 window: Optional[int] = None,
+                 num_kv_heads: Optional[int] = None):
         self.mesh = mesh
         self.n_stages = mesh.shape["stage"]
         if n_chunks < 1:
@@ -88,7 +95,7 @@ class PipelinedTrunk:
         self.microbatch_size = microbatch_size
         self.stage = TrunkStage(num_layers // n_virtual, num_heads,
                                 mlp_dim, causal, dtype, attention_fn,
-                                dropout_rate)
+                                dropout_rate, rope, window, num_kv_heads)
 
     def init(self, rng: jax.Array, example: jnp.ndarray) -> Any:
         """Stacked per-stage params: ``(S, ...)`` leaves, or ``(V, S, ...)``

@@ -37,18 +37,22 @@ except AttributeError:  # pragma: no cover
 NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() well-defined
 
 
-def _block_attention(q, k, v, m, l, acc, q_start, k_start, causal):
+def _block_attention(q, k, v, m, l, acc, q_start, k_start, causal,
+                     window=None):
     """Fold one visiting K/V block into the online-softmax accumulator.
 
     Shapes: q (B,H,Tq,D); k,v (B,H,Tk,D); m,l (B,H,Tq); acc (B,H,Tq,D).
     ``q_start``/``k_start`` are the blocks' global sequence offsets (for the
-    causal mask across blocks).
+    causal / sliding-window mask across blocks).
     """
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(q.shape[-1] * 1.0)
     if causal:
         q_pos = q_start + jnp.arange(q.shape[2])
         k_pos = k_start + jnp.arange(k.shape[2])
         mask = q_pos[:, None] >= k_pos[None, :]
+        if window is not None:
+            mask = jnp.logical_and(mask,
+                                   q_pos[:, None] - k_pos[None, :] < window)
         scores = jnp.where(mask[None, None], scores, NEG_INF)
     block_max = jnp.max(scores, axis=-1)
     new_m = jnp.maximum(m, block_max)
@@ -61,6 +65,7 @@ def _block_attention(q, k, v, m, l, acc, q_start, k_start, causal):
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                    mesh: Mesh, axis: str = "seq", causal: bool = False,
+                   window: int | None = None,
                    batch_axes: tuple[str, ...] = ("data", "fsdp")
                    ) -> jnp.ndarray:
     """Exact multi-head attention with the sequence sharded over ``axis``.
@@ -71,9 +76,17 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
       mesh: mesh containing `axis`; composes with data parallelism.
       causal: standard autoregressive mask, applied across blocks via
         global positions.
+      window: optional causal sliding-window size (each query attends to
+        its last ``window`` global positions).  Masked via the same
+        global-position arithmetic as the causal mask; the hop-0 diagonal
+        block guarantees every query row folds at least its own position
+        first, so later fully-masked blocks contribute exp(-inf)=0.
 
     Returns ``(B, T, H, D)`` attention output, sharded like ``q``.
     """
+    if window is not None and not causal:
+        raise ValueError("window (sliding-window attention) requires "
+                         "causal=True")
     S = mesh.shape[axis]
     B, T, H, D = q.shape
     if T % S:
@@ -102,7 +115,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
             # the block visiting at hop r originated on device (my - r) mod S
             k_start = ((my - r) % S) * Tl
             m, l, acc = _block_attention(q_, k_blk, v_blk, m, l, acc,
-                                         q_start, k_start, causal)
+                                         q_start, k_start, causal, window)
             k_blk = lax.ppermute(k_blk, axis, perm)
             v_blk = lax.ppermute(v_blk, axis, perm)
             return (k_blk, v_blk, m, l, acc), None
@@ -128,14 +141,14 @@ def make_attention_fn(mesh: Mesh, axis: str = "seq", causal: bool = False):
     forced_causal = causal
 
     def attn(q, k, v, *, mask=None, key_valid=None, causal=False,
-             dtype=jnp.float32):
+             window=None, dtype=jnp.float32):
         if mask is not None or key_valid is not None:
             raise NotImplementedError(
                 "ring attention computes its causal mask internally from "
                 "global positions; explicit mask tensors are unsupported "
                 "(pad to block boundaries instead)")
         out = ring_attention(q, k, v, mesh=mesh, axis=axis,
-                             causal=causal or forced_causal)
+                             causal=causal or forced_causal, window=window)
         return out.astype(dtype)
 
     return attn

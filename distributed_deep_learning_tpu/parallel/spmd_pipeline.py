@@ -170,12 +170,33 @@ def one_f_one_b_schedule(n_microbatches: int, n_stages: int
     return ops
 
 
+def _mb_key_fn(rng, mesh, batch_axes):
+    """Per-(stage, microbatch) dropout-key derivation shared by the
+    hand-scheduled pipelines: deterministic given ``rng``, distinct per
+    (virtual) stage, microbatch and data shard.  The SAME key is derived
+    for a microbatch's forward and its rematerialised backward, so the
+    recompute replays the identical dropout mask and gradients stay exact
+    — the property that previously forced ``--dropout`` onto the GPipe
+    schedule only."""
+    from jax import lax as _lax
+
+    def mb_key(stage_idx, m_idx):
+        key = jax.random.fold_in(jax.random.fold_in(rng, stage_idx), m_idx)
+        for a in batch_axes:
+            if mesh.shape.get(a, 1) > 1:
+                key = jax.random.fold_in(key, _lax.axis_index(a))
+        return key
+
+    return mb_key
+
+
 def spmd_pipeline_1f1b(stage_fn: StageFn, head_loss_fn, stacked_params: Any,
                        head_params: Any, x: jnp.ndarray, targets: Any, *,
                        mesh: Mesh, microbatch_size: int | None = None,
                        axis: str = "stage",
                        batch_axes: tuple[str, ...] = ("data", "fsdp"),
-                       has_aux: bool = False):
+                       has_aux: bool = False,
+                       rng: jnp.ndarray | None = None):
     """One-forward-one-backward pipelined TRAIN pass in a single scan.
 
     The GPipe path (:func:`spmd_pipeline` under ``jax.grad``) lets the scan
@@ -202,6 +223,12 @@ def spmd_pipeline_1f1b(stage_fn: StageFn, head_loss_fn, stacked_params: Any,
     With ``has_aux=True``, ``head_loss_fn`` returns ``(scalar, aux_tree)``
     (e.g. correct/count metric counters); aux leaves are SUMMED over
     microbatches and all mesh axes and appended as a fifth return value.
+
+    ``rng`` enables train-time stochasticity exactly as in
+    :func:`spmd_pipeline`: ``stage_fn(params, x, key)`` is called with a
+    per-(stage, microbatch) key; the rematerialised backward derives the
+    SAME key for its recompute, so dropout stays exact under the
+    hand-rolled vjp.
     """
     S = mesh.shape[axis]
     B = x.shape[0]
@@ -224,6 +251,7 @@ def spmd_pipeline_1f1b(stage_fn: StageFn, head_loss_fn, stacked_params: Any,
         s = lax.axis_index(axis)
         fperm = [(i, (i + 1) % S) for i in range(S)]
         bperm = [(i, (i - 1) % S) for i in range(S)]
+        mb_key = None if rng is None else _mb_key_fn(rng, mesh, batch_axes)
         zeros_g = lambda tree: jax.tree.map(  # noqa: E731
             lambda a: jnp.zeros(a.shape, jnp.float32), tree)
 
@@ -241,7 +269,10 @@ def spmd_pipeline_1f1b(stage_fn: StageFn, head_loss_fn, stacked_params: Any,
                             lax.dynamic_index_in_dim(
                                 xs, jnp.clip(f, 0, M - 1), keepdims=False),
                             fwd_in)
-            out = stage_fn(params, inp)
+            if mb_key is None:
+                out = stage_fn(params, inp)
+            else:
+                out = stage_fn(params, inp, mb_key(s, jnp.clip(f, 0, M - 1)))
             # park the stage input in its ring slot (keep the old value on
             # non-forward ticks so a live slot is never clobbered)
             slot_f = jnp.clip(f, 0, M - 1) % R
@@ -253,7 +284,13 @@ def spmd_pipeline_1f1b(stage_fn: StageFn, head_loss_fn, stacked_params: Any,
             do_b = jnp.logical_and(b >= 0, b < M)
             bc = jnp.clip(b, 0, M - 1)
             rin = lax.dynamic_index_in_dim(resid, bc % R, keepdims=False)
-            y2, stage_vjp = jax.vjp(lambda p, a: stage_fn(p, a), params, rin)
+            if mb_key is None:
+                y2, stage_vjp = jax.vjp(lambda p, a: stage_fn(p, a),
+                                        params, rin)
+            else:
+                kb = mb_key(s, bc)  # same key as microbatch bc's forward
+                y2, stage_vjp = jax.vjp(lambda p, a: stage_fn(p, a, kb),
+                                        params, rin)
             tgt = jax.tree.map(
                 lambda a: lax.dynamic_index_in_dim(a, bc, keepdims=False),
                 ts)
@@ -282,7 +319,9 @@ def spmd_pipeline_1f1b(stage_fn: StageFn, head_loss_fn, stacked_params: Any,
 
         z = jnp.zeros_like(xs[0])
         if has_aux:
-            y_s = jax.eval_shape(stage_fn, params, xs[0])
+            f_args = (params, xs[0]) if mb_key is None else \
+                (params, xs[0], rng)
+            y_s = jax.eval_shape(stage_fn, *f_args)
             aux_shape = jax.eval_shape(
                 head_loss_fn, head_params, y_s,
                 jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape[1:],
@@ -401,8 +440,13 @@ def interleaved_1f1b_schedule(n_microbatches: int, n_stages: int,
                 ops.append((t, s, "F", v // S, m))
                 progressed = True
                 # the last virtual stage may backward the same microbatch
-                # in the same tick (seeded by the in-tick head loss)
+                # in the same tick (seeded by the in-tick head loss) — but
+                # only under the SAME cotangent flow-control bound the
+                # normal B path enforces (ADVICE r3: an unguarded append
+                # could overrun the receiver's 2-deep parity buffer)
                 if v == L - 1 and b_count[v] == m and \
+                        (v == 0 or
+                         b_count[v] - b_count[v - 1] < max_in_flight) and \
                         (s, t) not in {(o[1], o[0]) for o in ops
                                        if o[2] == "B"}:
                     b_done[(v, m)] = t
@@ -477,7 +521,8 @@ def spmd_pipeline_interleaved(stage_fn: StageFn, head_loss_fn,
                               mesh: Mesh, microbatch_size: int | None = None,
                               axis: str = "stage",
                               batch_axes: tuple[str, ...] = ("data", "fsdp"),
-                              has_aux: bool = False):
+                              has_aux: bool = False,
+                              rng: jnp.ndarray | None = None):
     """Interleaved-1F1B pipelined TRAIN pass: ``V`` chunks per device.
 
     Same contract as :func:`spmd_pipeline_1f1b` except ``stacked_params``
@@ -493,6 +538,10 @@ def spmd_pipeline_interleaved(stage_fn: StageFn, head_loss_fn,
 
     Returns ``(loss, trunk_grads, head_grads, dx[, aux])`` with
     ``trunk_grads`` in the (V, S, ...) stacked layout.
+
+    ``rng`` enables dropout: per-(virtual stage, microbatch) keys, with the
+    backward recompute deriving the identical key (see
+    :func:`spmd_pipeline_1f1b`).
     """
     S = mesh.shape[axis]
     V = jax.tree.leaves(stacked_params)[0].shape[0]
@@ -518,6 +567,7 @@ def spmd_pipeline_interleaved(stage_fn: StageFn, head_loss_fn,
         s = lax.axis_index(axis)
         fperm = [(i, (i + 1) % S) for i in range(S)]
         bperm = [(i, (i - 1) % S) for i in range(S)]
+        mb_key = None if rng is None else _mb_key_fn(rng, mesh, batch_axes)
         zeros_g = lambda tree: jax.tree.map(  # noqa: E731
             lambda a: jnp.zeros(a.shape, jnp.float32), tree)
 
@@ -557,7 +607,11 @@ def spmd_pipeline_interleaved(stage_fn: StageFn, head_loss_fn,
             x0 = lax.dynamic_index_in_dim(xs, fmc, keepdims=False)
             f_in = jnp.where(jnp.logical_and(s == 0, fc == 0), x0,
                              fbuf[fcl, fmc % 2])
-            out = stage_fn(pick_chunk(params, fcl), f_in)
+            if mb_key is None:
+                out = stage_fn(pick_chunk(params, fcl), f_in)
+            else:  # key by GLOBAL virtual stage v = c*S + s
+                out = stage_fn(pick_chunk(params, fcl), f_in,
+                               mb_key(fcl * S + s, fmc))
             old = resid[fcl, fmc % R]
             resid = resid.at[fcl, fmc % R].set(jnp.where(do_f, f_in, old))
             # ---- backward ----
@@ -565,7 +619,12 @@ def spmd_pipeline_interleaved(stage_fn: StageFn, head_loss_fn,
             bmc = jnp.clip(bm, 0, M - 1)
             pb = pick_chunk(params, bcl)
             rin = resid[bcl, bmc % R]
-            y2, stage_vjp = jax.vjp(lambda p, a: stage_fn(p, a), pb, rin)
+            if mb_key is None:
+                y2, stage_vjp = jax.vjp(lambda p, a: stage_fn(p, a), pb, rin)
+            else:  # same key as this (virtual stage, microbatch)'s forward
+                kb = mb_key(bcl * S + s, bmc)
+                y2, stage_vjp = jax.vjp(lambda p, a: stage_fn(p, a, kb),
+                                        pb, rin)
             tgt = jax.tree.map(
                 lambda a: lax.dynamic_index_in_dim(a, bmc, keepdims=False),
                 ts)
@@ -604,9 +663,10 @@ def spmd_pipeline_interleaved(stage_fn: StageFn, head_loss_fn,
 
         z = jnp.zeros_like(xs[0])
         if has_aux:
-            y_s = jax.eval_shape(stage_fn,
-                                 jax.tree.map(lambda p: p[0], params),
-                                 xs[0])
+            f_args = ((jax.tree.map(lambda p: p[0], params), xs[0])
+                      if mb_key is None else
+                      (jax.tree.map(lambda p: p[0], params), xs[0], rng))
+            y_s = jax.eval_shape(stage_fn, *f_args)
             aux_shape = jax.eval_shape(
                 head_loss_fn, head_params, y_s,
                 jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape[1:],

@@ -41,13 +41,20 @@ except AttributeError:  # pragma: no cover
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                       mesh: Mesh, axis: str = "seq", causal: bool = False,
+                      window: int | None = None,
                       attention_fn=None) -> jnp.ndarray:
     """Exact attention on ``(B, T, H, D)`` q/k/v sharded over ``axis`` in T.
 
     ``attention_fn(q, k, v, causal=..., dtype=...)`` runs the local
     full-sequence attention per head group (default: the package's dense
-    softmax; pass the flash adapter for the fused kernel).
+    softmax; pass the flash adapter for the fused kernel).  ``window`` (a
+    causal sliding-window size) is forwarded to the local call — after the
+    head-scatter all-to-all every device holds the FULL sequence, so the
+    inner kernel applies the band exactly as in the unsharded case.
     """
+    if window is not None and not causal:
+        raise ValueError("window (sliding-window attention) requires "
+                         "causal=True")
     S = mesh.shape[axis]
     B, T, H, D = q.shape
     if H % S:
@@ -76,7 +83,9 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                                   tiled=True)
 
         qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-        oh = attention_fn(qh, kh, vh, causal=causal, dtype=qh.dtype)
+        inner_kw = {} if window is None else {"window": window}
+        oh = attention_fn(qh, kh, vh, causal=causal, dtype=qh.dtype,
+                          **inner_kw)
         # mirror: scatter sequence back, gather heads
         return lax.all_to_all(oh, axis, split_axis=1, concat_axis=2,
                               tiled=True)
@@ -93,14 +102,14 @@ def make_attention_fn(mesh: Mesh, axis: str = "seq", causal: bool = False,
     forced_causal = causal
 
     def attn(q, k, v, *, mask=None, key_valid=None, causal=False,
-             dtype=jnp.float32):
+             window=None, dtype=jnp.float32):
         if mask is not None or key_valid is not None:
             raise NotImplementedError(
                 "ulysses attention does not thread padding masks through "
                 "the all-to-all (pad to block boundaries instead)")
         out = ulysses_attention(q, k, v, mesh=mesh, axis=axis,
                                 causal=causal or forced_causal,
-                                attention_fn=inner)
+                                window=window, attention_fn=inner)
         return out.astype(dtype)
 
     return attn

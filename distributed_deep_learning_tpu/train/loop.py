@@ -82,7 +82,11 @@ def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
     logger = logger or PhaseLogger(verbose=False)
     history: list[EpochResult] = []
 
+    from distributed_deep_learning_tpu.utils.failures import (
+        maybe_inject_failure)
+
     for epoch in range(start_epoch, epochs + 1):  # reference counts from 1
+        maybe_inject_failure(epoch)  # chaos drill (DDL_INJECT_FAILURE)
         train_loader.set_epoch(epoch)
         t0 = logger.phase_begin("train", epoch)
         state, totals = _run_phase(train_step, state, train_loader,

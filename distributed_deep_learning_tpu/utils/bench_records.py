@@ -1,0 +1,55 @@
+"""Shared access to the repo's recorded measurement history.
+
+``bench_baseline.json`` (repo root) is the single source of truth for
+hardware numbers this framework has actually measured on itself — the
+reference publishes none (BASELINE.md), so decisions that depend on "is X
+faster than Y *here*" read this file rather than assuming.  This module
+owns the key names and the path derivation so ``bench.py``,
+``scripts/tpu_validation.py`` and the ``--attention auto`` gate
+(:func:`..workloads.northstar._attention_fn`) can never drift apart.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+#: flash-vs-dense fwd+bwd step-time ratio at the bench micro shape
+#: (B=4, T=2048, H=8, D=64, bf16); > 1 means flash is faster
+FLASH_GATE_KEY = "tpu:flash_speedup_T2048_D64"
+
+
+def baseline_path() -> str:
+    """Absolute path of ``bench_baseline.json`` at the repo root."""
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "bench_baseline.json")
+
+
+def read_records() -> dict:
+    try:
+        with open(baseline_path()) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def read_flash_speedup() -> float | None:
+    """Last recorded flash-vs-dense ratio; None when never measured."""
+    v = read_records().get(FLASH_GATE_KEY)
+    try:
+        return float(v) if v is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
+def record_flash_speedup(value: float) -> None:
+    """Persist the latest measured ratio (latest wins — it is a decision
+    datum for the ``--attention auto`` gate, not a first-run baseline)."""
+    records = read_records()
+    records[FLASH_GATE_KEY] = round(float(value), 4)
+    try:
+        with open(baseline_path(), "w") as f:
+            json.dump(records, f, indent=1)
+    except OSError:
+        pass

@@ -151,3 +151,49 @@ class FailureMonitor:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+_injected = False
+
+
+def maybe_inject_failure(epoch: int) -> None:
+    """Chaos/fault-injection hook: ``DDL_INJECT_FAILURE="<rank>:<epoch>"``
+    raises ONE ``RuntimeError`` at the start of that epoch on that rank.
+
+    Validates the elastic-recovery loop end to end — the failing rank's
+    :func:`..train.elastic.fit_with_recovery` catches the error, restores
+    the last epoch checkpoint, and rejoins its peers (who block briefly at
+    the next collective, exactly as on a real pod).  The reference has no
+    failure-drill mechanism at all (its only coupling is one trailing
+    barrier, ``CNN/main.py:183-184``); this is the operational answer:
+    a recovery path you can rehearse is one you can trust.
+    """
+    global _injected
+    spec = os.environ.get("DDL_INJECT_FAILURE")
+    if not spec or _injected:
+        return
+    # "<rank>:<epoch>" with rank a number or "all" (pod preemption drill);
+    # validate eagerly — a malformed spec must be one clear error, not a
+    # cryptic crash at the start of every epoch (and recovery churn under
+    # --elastic, which would catch-and-retry into the same parse failure)
+    parts = spec.split(":")
+    if len(parts) != 2 or (parts[0] != "all" and not parts[0].isdigit()) \
+            or not parts[1].isdigit():
+        raise ValueError(
+            f"DDL_INJECT_FAILURE={spec!r}: expected '<rank>:<epoch>' with "
+            "rank a process index or 'all', e.g. '1:2' or 'all:2'")
+    rank_s, epoch_s = parts
+    import jax
+
+    hit = rank_s == "all" or jax.process_index() == int(rank_s)
+    if hit and epoch == int(epoch_s):
+        _injected = True
+        import sys
+
+        # stderr, not the PhaseLogger: non-coordinator ranks log nothing,
+        # but the drill must be visible in every rank's output
+        print(f"CHAOS: injected failure on rank {jax.process_index()} "
+              f"at epoch {epoch_s}", file=sys.stderr, flush=True)
+        raise RuntimeError(
+            f"injected failure (DDL_INJECT_FAILURE={spec}) on rank "
+            f"{jax.process_index()} at epoch {epoch_s}")

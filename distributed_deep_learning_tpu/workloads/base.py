@@ -79,6 +79,11 @@ class WorkloadSpec:
     # optional: (config, final_state, logger, dataset) hook after
     # training — e.g. the gpt workload's --generate sample printer
     post_train: Callable[[Config, Any, Any, Any], None] | None = None
+    # optional: (config, dataset) validation BEFORE training starts —
+    # rejects configs whose post_train hook would fail only after the
+    # expensive part has already run (e.g. --generate N > what the
+    # dataset-derived max_len admits)
+    pre_train_check: Callable[[Config, Any], None] | None = None
 
 
 def config_dtype(config: Config) -> jnp.dtype:
@@ -384,10 +389,16 @@ def _make_1f1b_train_step(mesh, model, loss_fn, state_spec, microbatch,
             state.params["embed"])
         pipeline = (spmd_pipeline_interleaved if interleaved
                     else spmd_pipeline_1f1b)
+        # --dropout: per-(stage, microbatch) keys derived inside the
+        # pipeline; the rematerialised backward replays the same keys, so
+        # the hand-rolled schedules stay exact (previously gpipe-only)
+        rngs = state.step_rngs()
+        fn = stage_fn if rngs is None else model.trunk.stage_fn_train()
         loss, tg, hg, dh, aux = pipeline(
-            stage_fn, head_loss, state.params["trunk"],
+            fn, head_loss, state.params["trunk"],
             state.params["head"], h, y, mesh=mesh,
-            microbatch_size=microbatch, has_aux=True)
+            microbatch_size=microbatch, has_aux=True,
+            rng=None if rngs is None else rngs["dropout"])
         (de,) = embed_vjp(dh.astype(h.dtype))
         grads = {"embed": de,
                  "trunk": jax.tree.map(lambda g, p: g.astype(p.dtype), tg,
@@ -432,11 +443,6 @@ def _run_spmd_pipelined(spec: WorkloadSpec, config: Config, devices, logger,
     if n_dev % n_stages:
         raise ValueError(f"--nstages {n_stages} must divide the device "
                          f"count {n_dev} (the rest becomes the data axis)")
-    if config.dropout > 0 and config.pipeline_schedule != "gpipe":
-        raise ValueError(f"--pipeline-schedule {config.pipeline_schedule} "
-                         "recomputes forward in its hand-rolled backward "
-                         "and stays deterministic; --dropout needs the "
-                         "gpipe schedule (or -m data)")
     if config.pipeline_schedule == "interleaved" and \
             config.virtual_stages < 2:
         raise ValueError(f"--pipeline-schedule interleaved needs "
@@ -526,11 +532,6 @@ def run_workload(spec: WorkloadSpec, config: Config
         raise ValueError(f"--pos {config.pos_embedding} is a gpt option; "
                          f"workload {spec.name!r} uses its own position "
                          "scheme")
-    if config.pos_embedding != "learned" and config.mode in (
-            Mode.MODEL, Mode.PIPELINE):
-        raise ValueError("--pos rope is implemented for the whole-model "
-                         "modes (-m data/sequential); staged/pipelined gpt "
-                         "trunks use learned positions")
     if config.attention_window is not None:
         if config.attention_window < 1:
             raise ValueError(f"--window must be >= 1, got "
@@ -539,9 +540,6 @@ def run_workload(spec: WorkloadSpec, config: Config
             raise ValueError(f"--window needs a causal decoder-only model; "
                              f"workload {spec.name!r} has bidirectional or "
                              "cross attention sites")
-        if config.mode in (Mode.MODEL, Mode.PIPELINE):
-            raise ValueError("--window is implemented for the whole-model "
-                             "modes (-m data/sequential)")
     if config.label_smoothing:
         if not 0.0 < config.label_smoothing < 1.0:
             raise ValueError(f"--label-smoothing must be in (0, 1), got "
@@ -558,11 +556,10 @@ def run_workload(spec: WorkloadSpec, config: Config
             raise ValueError("--kv-heads (grouped-query attention) is a "
                              f"gpt option; workload {spec.name!r} models "
                              "define their own head layout")
-        if config.mode in (Mode.MODEL, Mode.PIPELINE):
-            raise ValueError("--kv-heads is implemented for the "
-                             "whole-model modes (-m data/sequential)")
     try:
         dataset = spec.build_dataset(config)
+        if spec.pre_train_check is not None:
+            spec.pre_train_check(config, dataset)
         state, history = _run_workload(spec, config, devices, logger,
                                        dataset)
         if config.generate_tokens and spec.post_train is not None:

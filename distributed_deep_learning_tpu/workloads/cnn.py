@@ -24,6 +24,9 @@ NUM_CLASSES = 6  # PCB defect classes (reference CNN/dataset.py class dirs)
 
 
 def _dataset(config: Config):
+    if config.data_dir:
+        # an explicit --data-dir must fail loudly, not silently fall back
+        return PCBDataset(root=config.data_dir, seed=config.seed)
     try:
         return PCBDataset(seed=config.seed)
     except FileNotFoundError:

@@ -28,6 +28,14 @@ NUM_TARGETS = 5
 
 
 def _dataset(config: Config):
+    if config.data_dir:
+        # an explicit --data-dir must fail loudly, not silently fall back;
+        # instances_per_machine=None: whole file = one machine (fixtures /
+        # arbitrary CSVs; the reference's 8759 is its dataset's constant)
+        import os
+
+        return load_pdm(os.path.join(config.data_dir, "dataset.csv"),
+                        instances_per_machine=None)
     try:
         return load_pdm()
     except FileNotFoundError:

@@ -23,6 +23,11 @@ NUM_CLASSES = 5
 
 
 def _dataset(config: Config):
+    if config.data_dir:
+        # an explicit --data-dir must fail loudly, not silently fall back
+        import os
+
+        return load_mqtt(os.path.join(config.data_dir, "dataset.csv"))
     try:
         return load_mqtt()
     except FileNotFoundError:

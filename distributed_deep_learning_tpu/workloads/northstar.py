@@ -175,15 +175,37 @@ def _transformer_model(config: Config, dataset):
     return Seq2SeqAdapter(inner, src_len)
 
 
+def _measured_flash_speedup() -> float | None:
+    """The last RECORDED flash-vs-dense ratio from the bench's attention
+    micro; None when never measured (``utils.bench_records`` owns the key
+    and file)."""
+    from distributed_deep_learning_tpu.utils.bench_records import (
+        read_flash_speedup)
+
+    return read_flash_speedup()
+
+
 def _attention_fn(config: Config):
     """Resolve ``--attention``: the Pallas flash kernel is the TPU default
     for the transformer family (in-kernel causal + padding masks, no (T×T)
-    score materialisation); dense elsewhere, and either can be forced."""
+    score materialisation); dense elsewhere, and either can be forced.
+
+    ``auto`` is DATA-GATED (VERDICT r4 item 8): if the benchmark has
+    recorded a flash-vs-dense ratio below 1.0 on this repo's own hardware
+    history, auto resolves to dense even on TPU — the default must never
+    be slower than what it replaced.  Forcing ``--attention flash``
+    bypasses the gate.
+    """
     choice = config.attention
     if choice == "auto":
         import jax
 
-        choice = "flash" if jax.default_backend() == "tpu" else "dense"
+        if jax.default_backend() == "tpu":
+            speedup = _measured_flash_speedup()
+            choice = "dense" if speedup is not None and speedup < 1.0 \
+                else "flash"
+        else:
+            choice = "dense"
     if choice == "flash":
         from distributed_deep_learning_tpu.ops.attention_pallas import (
             make_attention_fn)
@@ -422,9 +444,13 @@ def _gpt_layers(config: Config, dataset):
     d = config.size
     dtype = config_dtype(config)
     max_len = max(dataset.features.shape[1], 8)
-    return [LMEmbed(_vocab(dataset), d, max_len=max_len, dtype=dtype)] + [
+    return [LMEmbed(_vocab(dataset), d, max_len=max_len, dtype=dtype,
+                    pos_embedding=config.pos_embedding)] + [
         TransformerLayer(max(2, d // 64), 4 * d, dropout_rate=0.0,
-                         causal=True, dtype=dtype)
+                         causal=True, dtype=dtype,
+                         rope=config.pos_embedding == "rope",
+                         window=config.attention_window,
+                         num_kv_heads=config.num_kv_heads)
         for _ in range(config.num_layers)
     ] + [LMHead(_vocab(dataset), dtype=dtype)]  # predict at every position
 
@@ -442,7 +468,35 @@ def _gpt_pipelined(config: Config, dataset, mesh):
                        dtype=config_dtype(config),
                        attention_fn=_attention_fn(config),
                        dropout_rate=config.dropout,
-                       n_chunks=_n_chunks(config))
+                       n_chunks=_n_chunks(config),
+                       pos_embedding=config.pos_embedding,
+                       attention_window=config.attention_window,
+                       num_kv_heads=config.num_kv_heads)
+
+
+#: prompt length _gpt_generate slices from the dataset (rows 0-1)
+_GENERATE_PROMPT_LEN = 8
+
+
+def _gpt_pre_check(config: Config, dataset) -> None:
+    """Reject an impossible ``--generate N`` BEFORE training: generate()
+    checks prompt + N <= max_len itself, but only after the expensive part
+    has finished (ADVICE r3).  Staged/pipelined modes are exempt —
+    :func:`_gpt_generate` skips generation there with a notice, so the
+    length can never be exercised and a pre-train error would reject runs
+    that previously completed."""
+    from distributed_deep_learning_tpu.utils.config import Mode
+
+    if not config.generate_tokens or config.mode in (Mode.MODEL,
+                                                     Mode.PIPELINE):
+        return
+    max_len = max(dataset.features.shape[1], 8)  # mirrors _gpt_model
+    prompt = min(_GENERATE_PROMPT_LEN, dataset.features.shape[1])
+    if prompt + config.generate_tokens > max_len:
+        raise ValueError(
+            f"--generate {config.generate_tokens}: prompt {prompt} + new "
+            f"tokens exceeds the model's max_len {max_len} (the dataset "
+            f"sequence length); at most {max_len - prompt} tokens fit")
 
 
 def _gpt_generate(config: Config, state, logger, dataset) -> None:
@@ -462,7 +516,8 @@ def _gpt_generate(config: Config, state, logger, dataset) -> None:
                     "parameter tree (-m data or sequential)")
         return
     model = _gpt_model(config, dataset)
-    prompts = jnp.asarray(dataset.features[:2, :8], jnp.int32)
+    prompts = jnp.asarray(dataset.features[:2, :_GENERATE_PROMPT_LEN],
+                          jnp.int32)
     out = generate(model, params, prompts,
                    max_new_tokens=config.generate_tokens)
     for row_p, row_o in zip(prompts.tolist(), out.tolist()):
@@ -483,6 +538,7 @@ GPT_SPEC = WorkloadSpec(
     tp_rules=lambda c: transformer_tp_rules(),
     build_pipelined=_gpt_pipelined,
     post_train=_gpt_generate,
+    pre_train_check=_gpt_pre_check,
 )
 
 SPECS = {"resnet": RESNET_SPEC, "transformer": TRANSFORMER_SPEC,

@@ -10,7 +10,12 @@ for easy collection into PERFORMANCE.md.
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
+
+# runnable as `python scripts/tpu_validation.py` from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _sync(x):
@@ -73,10 +78,69 @@ def s2d_vs_plain(batch=128, steps=10):
             "speedup": round(ips_s2d / ips_plain, 4)}
 
 
+def batch_sweep(steps=10):
+    """MFU playbook step 1 (PERFORMANCE.md): per-chip batch 64/128/256 on
+    the headline ResNet-50 — the knee is where arithmetic intensity
+    saturates the MXU."""
+    import jax
+    import jax.numpy as jnp
+
+    from bench import chip_peak_flops, _train_throughput
+    from distributed_deep_learning_tpu.models.resnet import resnet50
+    from distributed_deep_learning_tpu.runtime.mesh import build_mesh
+
+    devices = jax.devices()
+    mesh = build_mesh({"data": len(devices)})
+    peak = chip_peak_flops(devices[0].device_kind)
+    rows = []
+    for per_chip in (64, 128, 256):
+        batch = per_chip * len(devices)
+        ips, fps = _train_throughput(
+            resnet50(dtype=jnp.bfloat16, stem_s2d=True), image_size=224,
+            num_classes=1000, batch=batch, steps=steps, mesh=mesh)
+        mfu = ips * fps / batch / peak if fps and peak else None
+        rows.append({"per_chip_batch": per_chip, "ips": round(ips, 1),
+                     "mfu": round(mfu, 4) if mfu else None})
+    return {"section": "batch_sweep", "rows": rows}
+
+
+def lm_tokens(steps=10):
+    """CausalLM tokens/sec/chip + MFU at the bench shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from bench import chip_peak_flops, _lm_throughput
+    from distributed_deep_learning_tpu.runtime.mesh import build_mesh
+
+    devices = jax.devices()
+    mesh = build_mesh({"data": len(devices)})
+    peak = chip_peak_flops(devices[0].device_kind)
+    batch, seq = 8 * len(devices), 2048
+    tps, fps = _lm_throughput(batch=batch, seq_len=seq, steps=steps,
+                              mesh=mesh, dtype=jnp.bfloat16)
+    mfu = tps * (fps / (batch * seq)) / peak if fps and peak else None
+    return {"section": "lm", "tokens_per_sec_per_chip": round(tps, 1),
+            "mfu": round(mfu, 4) if mfu else None}
+
+
+def _record_flash_gate(result: dict) -> None:
+    """Persist the measured ratio as the `--attention auto` gate datum."""
+    from distributed_deep_learning_tpu.utils.bench_records import (
+        record_flash_speedup)
+
+    record_flash_speedup(result["speedup"])
+
+
 def main():
-    for fn in (flash_vs_dense, s2d_vs_plain):
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    for fn in (flash_vs_dense, s2d_vs_plain, batch_sweep, lm_tokens):
         try:
-            print(json.dumps(fn()))
+            result = fn()
+            print(json.dumps(result))
+            if fn is flash_vs_dense and on_tpu:
+                _record_flash_gate(result)
         except Exception as exc:  # partial windows yield partial numbers
             print(json.dumps({"section": fn.__name__,
                               "error": f"{type(exc).__name__}: {exc}"}))

@@ -281,3 +281,37 @@ def test_sliding_window_with_padding():
                                      mask=_band_mask(16, 5))
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_adapter_forwards_window_through_layer():
+    """ADVICE r3 high: MultiHeadAttention(window=W) hands window= to the
+    adapter at call time; the flash adapter must accept and forward it to
+    the kernel (previously a fixed signature -> TypeError at trace time on
+    the default TPU pairing)."""
+    from distributed_deep_learning_tpu.models.transformer import (
+        MultiHeadAttention)
+
+    x = jax.random.normal(jax.random.key(13), (2, 32, 64))
+    dense = MultiHeadAttention(num_heads=4, window=4)
+    flash = MultiHeadAttention(num_heads=4, window=4,
+                               attention_fn=make_attention_fn(block_q=8,
+                                                              block_k=8))
+    params = dense.init(jax.random.key(0), x, x, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(flash.apply(params, x, x, causal=True)),
+        np.asarray(dense.apply(params, x, x, causal=True)),
+        rtol=2e-4, atol=1e-5)
+
+
+def test_adapter_call_time_window_wins_over_maker():
+    """A call-time window must override one baked into make_attention_fn."""
+    from distributed_deep_learning_tpu.models.transformer import (
+        dot_product_attention)
+
+    ks = jax.random.split(jax.random.key(14), 3)
+    q, k, v = (jax.random.normal(kk, (2, 32, 4, 16)) for kk in ks)
+    fn = make_attention_fn(block_q=8, block_k=8, window=16)
+    got = fn(q, k, v, causal=True, window=4)
+    expected = dot_product_attention(q, k, v, causal=True, window=4)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-4, atol=1e-5)

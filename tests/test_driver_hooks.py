@@ -119,6 +119,19 @@ def test_bench_fallback_no_recursion(monkeypatch):
         raise AssertionError("second-level failure must re-raise, not loop")
 
 
+def _probe_aware(fn):
+    """Wrap a fake subprocess.run: answer the orchestrator's backend probe
+    with probe-ok, delegate heavy attempts to ``fn``."""
+    def run(cmd, env=None, timeout=None, **kw):
+        if env.get("BENCH_PROBE") == "1":
+            class R:
+                returncode = 0
+                stdout = "probe-ok\n"
+            return R()
+        return fn(cmd, env=env, timeout=timeout, **kw)
+    return run
+
+
 def test_bench_orchestrator_backoff(monkeypatch):
     """Two hung TPU attempts skip straight to the CPU attempt; a passing
     attempt relays its JSON line and stops."""
@@ -136,12 +149,98 @@ def test_bench_orchestrator_backoff(monkeypatch):
             return R()
         raise subprocess.TimeoutExpired(cmd, timeout)
 
+    monkeypatch.setattr(subprocess, "run", _probe_aware(fake_run))
+    monkeypatch.delenv("BENCH_BATCH", raising=False)
+    monkeypatch.delenv("BENCH_BATCH_PER_CHIP", raising=False)
+    assert bench.orchestrate() == 0
+    # 256 timeout, 128 timeout, s2d attempt SKIPPED (2 failures), then cpu
+    assert calls == [("256", None), ("128", None), (None, "1")]
+
+
+def test_bench_orchestrator_fast_errors_reach_cpu(monkeypatch):
+    """Round-3 regression: attempts that FAIL fast (rc != 0, e.g. a TPU
+    erroring UNAVAILABLE) must count like timeouts — two of any kind and
+    the orchestrator takes the guaranteed CPU attempt instead of walking
+    the whole ladder."""
+    import bench
+
+    calls = []
+
+    def fake_run(cmd, env=None, timeout=None, **kw):
+        calls.append((env.get("BENCH_BATCH_PER_CHIP"),
+                      env.get("BENCH_CPU_FALLBACK")))
+
+        class R:
+            returncode = 0 if env.get("BENCH_CPU_FALLBACK") == "1" else 1
+            stdout = '{"metric": "m", "value": 1}\n' \
+                if env.get("BENCH_CPU_FALLBACK") == "1" else ""
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", _probe_aware(fake_run))
+    monkeypatch.delenv("BENCH_BATCH", raising=False)
+    monkeypatch.delenv("BENCH_BATCH_PER_CHIP", raising=False)
+    assert bench.orchestrate() == 0
+    assert calls == [("256", None), ("128", None), (None, "1")]
+
+
+def test_bench_orchestrator_probe_failure_goes_straight_to_cpu(monkeypatch):
+    """A dead/hung backend is detected by the cheap probe; no heavy TPU
+    attempt is ever spawned."""
+    import bench
+
+    calls = []
+
+    def fake_run(cmd, env=None, timeout=None, **kw):
+        if env.get("BENCH_PROBE") == "1":
+            raise subprocess.TimeoutExpired(cmd, timeout)
+        calls.append((env.get("BENCH_BATCH_PER_CHIP"),
+                      env.get("BENCH_CPU_FALLBACK")))
+
+        class R:
+            returncode = 0
+            stdout = '{"metric": "m", "value": 1}\n'
+        return R()
+
     monkeypatch.setattr(subprocess, "run", fake_run)
     monkeypatch.delenv("BENCH_BATCH", raising=False)
     monkeypatch.delenv("BENCH_BATCH_PER_CHIP", raising=False)
     assert bench.orchestrate() == 0
-    # 256 timeout, 128 timeout, 64 SKIPPED (hung transport), then cpu
-    assert calls == [("256", None), ("128", None), (None, "1")]
+    assert calls == [(None, "1")]
+
+
+def test_bench_orchestrator_global_deadline(monkeypatch):
+    """Per-attempt timeouts are carved from the global budget: every
+    spawned attempt must fit inside BENCH_TIMEOUT, and the worker gets a
+    BENCH_DEADLINE to shed optional sections against."""
+    import bench
+
+    budgets = []
+
+    def fake_run(cmd, env=None, timeout=None, **kw):
+        assert env.get("BENCH_DEADLINE") is not None
+        budgets.append(timeout)
+        if env.get("BENCH_CPU_FALLBACK") == "1":
+            class R:
+                returncode = 0
+                stdout = '{"metric": "m", "value": 1}\n'
+            return R()
+        class R:
+            returncode = 1
+            stdout = ""
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", _probe_aware(fake_run))
+    monkeypatch.setenv("BENCH_TIMEOUT", "600")
+    monkeypatch.delenv("BENCH_BATCH", raising=False)
+    monkeypatch.delenv("BENCH_BATCH_PER_CHIP", raising=False)
+    try:
+        assert bench.orchestrate() == 0
+    finally:
+        monkeypatch.delenv("BENCH_TIMEOUT")
+    # each accelerator attempt leaves the CPU reserve untouched
+    assert all(b <= 600 * 0.6 + 1 for b in budgets[:-1])
+    # the CPU attempt keeps its floor even with budget spent
+    assert budgets[-1] >= 240
 
 
 def test_bench_orchestrator_first_attempt_wins(monkeypatch):
@@ -157,7 +256,7 @@ def test_bench_orchestrator_first_attempt_wins(monkeypatch):
             stdout = '{"metric": "m", "value": 2}\n'
         return R()
 
-    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(subprocess, "run", _probe_aware(fake_run))
     monkeypatch.delenv("BENCH_BATCH", raising=False)
     monkeypatch.delenv("BENCH_BATCH_PER_CHIP", raising=False)
     assert bench.orchestrate() == 0
@@ -177,7 +276,7 @@ def test_bench_orchestrator_respects_pinned_batch(monkeypatch):
             stdout = '{"metric": "m", "value": 3}\n'
         return R()
 
-    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(subprocess, "run", _probe_aware(fake_run))
     monkeypatch.setenv("BENCH_BATCH", "32")
     assert bench.orchestrate() == 0
     assert calls == ["32"]
@@ -199,10 +298,25 @@ def test_bench_cpu_attempt_strips_batch_pins(monkeypatch):
             return R()
         raise subprocess.TimeoutExpired(cmd, timeout)
 
-    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(subprocess, "run", _probe_aware(fake_run))
     monkeypatch.setenv("BENCH_BATCH", "2048")
     assert bench.orchestrate() == 0
-    assert calls == [("2048", None), (None, "1")]
+    # one failed pinned attempt is enough: budget-aware ladder goes to cpu
+    assert calls[0] == ("2048", None)
+    assert calls[-1] == (None, "1")
+
+
+def test_bench_worker_sheds_sections_past_deadline(monkeypatch):
+    import time as _t
+
+    import bench
+
+    monkeypatch.setenv("BENCH_DEADLINE", repr(_t.time() + 30))
+    assert bench._time_left() < 31
+    monkeypatch.setenv("BENCH_DEADLINE", repr(_t.time() + 1000))
+    assert 990 < bench._time_left() < 1001
+    monkeypatch.delenv("BENCH_DEADLINE")
+    assert bench._time_left() == float("inf")
 
 
 def test_bench_worker_fails_fast_on_init_error(monkeypatch):

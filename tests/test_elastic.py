@@ -142,3 +142,23 @@ def test_mid_epoch_failure_triggers_recovery(tmp_path, mesh8):
     finally:
         ckpt.close()
     assert [h.phase for h in history].count("train") == 2
+
+
+def test_inject_failure_spec_validation(monkeypatch):
+    """Malformed DDL_INJECT_FAILURE is one clear error, not a cryptic
+    unpack crash repeated every epoch."""
+    import pytest
+
+    from distributed_deep_learning_tpu.utils import failures
+
+    for bad in ("2", "all:two", "1:2:3", "x:1"):
+        monkeypatch.setenv("DDL_INJECT_FAILURE", bad)
+        with pytest.raises(ValueError, match="DDL_INJECT_FAILURE"):
+            failures.maybe_inject_failure(1)
+
+    monkeypatch.setenv("DDL_INJECT_FAILURE", "0:2")
+    failures.maybe_inject_failure(1)  # wrong epoch: no-op
+    with pytest.raises(RuntimeError, match="injected failure"):
+        failures.maybe_inject_failure(2)
+    failures.maybe_inject_failure(2)  # fires at most once per process
+    failures._injected = False        # reset for other tests

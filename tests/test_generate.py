@@ -46,7 +46,9 @@ def test_generate_matches_uncached_greedy():
     seq = prompt
     for _ in range(6):
         logits = model.apply({"params": params}, seq)
-        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(seq.dtype)
+        # generate() never emits pad id 0 — mirror that in the reference
+        nxt = jnp.argmax(logits[:, -1].at[:, 0].set(-jnp.inf),
+                         axis=-1).astype(seq.dtype)
         seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(seq[:, 4:]))
 
@@ -249,3 +251,36 @@ def test_top_k_sampling():
 
     with pytest.raises(ValueError, match="top_k"):
         generate(model, params, prompt, max_new_tokens=2, top_k=0)
+
+
+def test_generate_never_emits_pad_id():
+    """ADVICE r3: a generated 0 would be recorded invalid in the KV cache
+    (valid = tokens != 0) and silently vanish from later attention — so
+    id 0 is masked out of every pick, greedy and sampled."""
+    model = _model(with_logits=True)
+    prompt = jax.random.randint(jax.random.key(30), (4, 4), 1, 61)
+    params = model.init(jax.random.key(31), prompt)["params"]
+    for kw in ({}, {"temperature": 1.5, "rng": jax.random.key(32)},
+               {"temperature": 1.0, "top_k": 3, "rng": jax.random.key(33)}):
+        out = generate(model, params, prompt, max_new_tokens=8, **kw)
+        assert (np.asarray(out) != 0).all(), f"emitted pad id under {kw}"
+
+
+def test_gpt_generate_too_long_rejected_before_training():
+    """ADVICE r3: --generate N beyond what max_len admits must fail at
+    validation time, not after the expensive training run."""
+    import pytest
+
+    from distributed_deep_learning_tpu.workloads.northstar import (
+        _gpt_pre_check)
+
+    class DS:
+        features = np.zeros((4, 64), np.int32)
+
+    class Cfg:
+        generate_tokens = 56
+    _gpt_pre_check(Cfg(), DS())  # 8 + 56 == 64: fits
+
+    Cfg.generate_tokens = 57
+    with pytest.raises(ValueError, match="--generate"):
+        _gpt_pre_check(Cfg(), DS())

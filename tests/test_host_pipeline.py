@@ -142,3 +142,32 @@ def test_cli_parses_data_dir_flags():
     assert c.data_dir == "/tmp/x"
     assert c.image_size == 96
     assert c.num_workers == 4
+
+
+def test_prefetch_overlap_positive():
+    """VERDICT r4: prefetch must actually OVERLAP host batch formation
+    with (simulated) device compute — elapsed well under the serial sum."""
+    import time as _t
+
+    from distributed_deep_learning_tpu.data.loader import PrefetchLoader
+
+    n, cost = 6, 0.05
+
+    class SlowProducer:
+        def __iter__(self):
+            for i in range(n):
+                _t.sleep(cost)  # simulated decode/gather
+                yield i
+
+    t0 = _t.perf_counter()
+    for _ in SlowProducer():
+        _t.sleep(cost)          # simulated device step (serial baseline)
+    serial = _t.perf_counter() - t0
+
+    t0 = _t.perf_counter()
+    for _ in PrefetchLoader(SlowProducer(), depth=2):
+        _t.sleep(cost)
+    overlapped = _t.perf_counter() - t0
+    # perfect overlap -> ~serial/2 (+1 fill); require a real win with
+    # slack for loaded CI machines
+    assert overlapped < serial * 0.85, (overlapped, serial)

@@ -163,13 +163,36 @@ def test_pipeline_dropout_trains_and_is_seeded(monkeypatch):
     assert all(np.isfinite(v) for v in l1)
 
 
-def test_pipeline_dropout_rejected_under_1f1b(monkeypatch):
-    monkeypatch.setenv("DDL_DATA_LIMIT", "32")
-    config = Config(mode=Mode.PIPELINE, num_layers=2, size=32, epochs=1,
-                    batch_size=16, num_stages=2, dropout=0.1,
-                    pipeline_schedule="1f1b")
-    with pytest.raises(ValueError, match="1f1b"):
-        run_workload(BERT_SPEC, config)
+def test_pipeline_dropout_trains_under_1f1b(monkeypatch):
+    """VERDICT r3 item 5: --dropout now works under the hand-scheduled
+    1F1B schedule (the backward recompute replays the identical
+    per-(stage, microbatch) keys) — seeded, perturbing, finite."""
+    monkeypatch.setenv("DDL_DATA_LIMIT", "64")
+    base = dict(mode=Mode.PIPELINE, num_layers=2, size=32, epochs=1,
+                batch_size=16, num_stages=2, microbatch=8,
+                pipeline_schedule="1f1b")
+    _, h1 = run_workload(BERT_SPEC, Config(**base, dropout=0.2))
+    _, h2 = run_workload(BERT_SPEC, Config(**base, dropout=0.2))
+    _, h0 = run_workload(BERT_SPEC, Config(**base))
+    l1 = [h.loss for h in h1 if h.phase == "train"]
+    l2 = [h.loss for h in h2 if h.phase == "train"]
+    l0 = [h.loss for h in h0 if h.phase == "train"]
+    assert l1 == l2                      # seeded: identical reruns
+    assert l1 != l0                      # dropout actually perturbs
+    assert all(np.isfinite(v) for v in l1)
+
+
+def test_pipeline_dropout_trains_under_interleaved(monkeypatch):
+    monkeypatch.setenv("DDL_DATA_LIMIT", "64")
+    base = dict(mode=Mode.PIPELINE, num_layers=4, size=32, epochs=1,
+                batch_size=16, num_stages=2, microbatch=8,
+                pipeline_schedule="interleaved", virtual_stages=2)
+    _, h1 = run_workload(BERT_SPEC, Config(**base, dropout=0.2))
+    _, h2 = run_workload(BERT_SPEC, Config(**base, dropout=0.2))
+    l1 = [h.loss for h in h1 if h.phase == "train"]
+    l2 = [h.loss for h in h2 if h.phase == "train"]
+    assert l1 == l2
+    assert all(np.isfinite(v) for v in l1)
 
 
 def test_pipeline_elastic_keeps_dropout_rng(tmp_path, monkeypatch):

@@ -1,0 +1,146 @@
+"""Real-data end-to-end proof per reference workload (VERDICT r4 item 7):
+committed CSV fixtures (MQTT, PdM) and a generated VOC-style XML+JPG tree
+(PCB) driven through ALL FOUR modes via the CLI, asserting the reference
+log grammar and real learning on the planted signals — closing the loop on
+C13-C15 against ``/root/reference/src/pytorch/{MLP,CNN,LSTM}/dataset.py``
+semantics with actual file parsing (native C++ CSV reader, stdlib
+ElementTree, PIL decode + native crop/resize) on the path.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_deep_learning_tpu.utils.config import parse_args
+from distributed_deep_learning_tpu.workloads import get_spec, run_workload
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+MODES = ("sequential", "data", "model", "pipeline")
+
+
+def _run(workload, argv, limit=1024, capsys=None):
+    config = parse_args(argv, workload=workload)
+    old = os.environ.get("DDL_DATA_LIMIT")
+    os.environ["DDL_DATA_LIMIT"] = str(limit)
+    try:
+        return run_workload(get_spec(workload), config)
+    finally:
+        if old is None:
+            os.environ.pop("DDL_DATA_LIMIT", None)
+        else:
+            os.environ["DDL_DATA_LIMIT"] = old
+
+
+def _grammar_ok(out: str) -> None:
+    """The reference's quote-delimited phase-line grammar."""
+    import re
+
+    assert re.search(r'"train epoch 1 ends at .* with accuracy', out), out
+    assert re.search(r'"validation epoch 1 ends at .* with accuracy', out)
+    assert re.search(r'"test ends at .* with accuracy', out)
+
+
+def _phases(history):
+    return [h.phase for h in history]
+
+
+@pytest.fixture(scope="module")
+def pcb_root(tmp_path_factory):
+    """VOC-style tree: Annotations/<class>/*.xml + images/<class>/*.jpg
+    (reference ``CNN/dataset.py:71-111`` layout), generated JPEGs whose
+    mean colour encodes the class so the CNN can learn it."""
+    from PIL import Image
+
+    root = tmp_path_factory.mktemp("pcb")
+    rng = np.random.default_rng(5)
+    classes = [f"defect_{i}" for i in range(6)]
+    for ci, cls in enumerate(classes):
+        (root / "Annotations" / cls).mkdir(parents=True)
+        (root / "images" / cls).mkdir(parents=True)
+        for i in range(2):
+            arr = rng.integers(0, 60, (100, 100, 3)).astype(np.uint8)
+            arr[..., ci % 3] += np.uint8(40 * (1 + ci // 3))  # class signal
+            Image.fromarray(arr).save(root / "images" / cls / f"im{i}.jpg")
+            boxes = "".join(
+                f"<object><bndbox><xmin>{x0}</xmin><ymin>{y0}</ymin>"
+                f"<xmax>{x0 + 40}</xmax><ymax>{y0 + 40}</ymax>"
+                "</bndbox></object>"
+                for x0, y0 in ((5, 5), (50, 50)))
+            (root / "Annotations" / cls / f"im{i}.xml").write_text(
+                f"<annotation>{boxes}</annotation>")
+    return str(root)
+
+
+# --- MLP on the committed MQTT CSV (C13) -----------------------------------
+
+@pytest.mark.parametrize("mode", MODES)
+def test_mlp_real_csv_all_modes(mode, capsys):
+    argv = ["-e", "2", "-b", "32", "-m", mode,
+            "--data-dir", os.path.join(FIXTURES, "mqtt")]
+    if mode in ("model", "pipeline"):
+        argv += ["-l", "2", "--nstages", "2", "-e", "1"]
+        argv[1] = "1"
+    _, history = _run("mlp", argv)
+    assert _phases(history)[-1] == "test"
+    assert all(np.isfinite(h.loss) for h in history)
+    _grammar_ok(capsys.readouterr().out)
+
+
+def test_mlp_learns_planted_csv_signal():
+    _, history = _run("mlp", ["-e", "6", "-b", "32", "-m", "sequential",
+                              "--data-dir", os.path.join(FIXTURES, "mqtt")])
+    train = [h for h in history if h.phase == "train"]
+    assert train[-1].accuracy > train[0].accuracy
+    assert train[-1].accuracy > 40.0
+
+
+# --- CNN on the generated PCB VOC tree (C14) --------------------------------
+
+@pytest.mark.parametrize("mode", MODES)
+def test_cnn_real_voc_all_modes(mode, pcb_root, capsys):
+    argv = ["-e", "1", "-b", "16", "-m", mode, "--data-dir", pcb_root]
+    if mode in ("model", "pipeline"):
+        argv += ["-l", "2", "--nstages", "2"]
+    _, history = _run("cnn", argv, limit=48)
+    assert _phases(history)[-1] == "test"
+    assert all(np.isfinite(h.loss) for h in history)
+    _grammar_ok(capsys.readouterr().out)
+
+
+def test_cnn_augmentation_doubles_real_samples(pcb_root):
+    from distributed_deep_learning_tpu.data.pcb import PCBDataset
+
+    ds = PCBDataset(root=pcb_root, seed=0)
+    # 6 classes x 2 images x 2 boxes = 24 physical samples, doubled
+    assert len(ds) == 48
+
+
+# --- LSTM on the committed windowed PdM CSV (C15) ---------------------------
+
+@pytest.mark.parametrize("mode", MODES)
+def test_lstm_real_csv_all_modes(mode, capsys):
+    argv = ["-e", "1", "-b", "32", "-m", mode,
+            "--data-dir", os.path.join(FIXTURES, "pdm")]
+    if mode in ("model", "pipeline"):
+        argv += ["-l", "2", "--nstages", "2"]
+    _, history = _run("lstm", argv)
+    assert _phases(history)[-1] == "test"
+    assert all(np.isfinite(h.loss) for h in history)
+    _grammar_ok(capsys.readouterr().out)
+
+
+def test_lstm_loss_improves_on_real_csv():
+    _, history = _run("lstm", ["-e", "3", "-b", "32", "-m", "sequential",
+                               "--data-dir", os.path.join(FIXTURES, "pdm")])
+    train = [h for h in history if h.phase == "train"]
+    assert train[-1].loss < train[0].loss
+
+
+def test_explicit_data_dir_fails_loudly(tmp_path):
+    """--data-dir pointing nowhere must raise, not silently fall back to
+    the synthetic twin."""
+    with pytest.raises(FileNotFoundError):
+        _run("mlp", ["-e", "1", "-b", "32", "--data-dir",
+                     str(tmp_path / "nope")])

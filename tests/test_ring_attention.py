@@ -111,3 +111,45 @@ def test_attention_fn_rejects_explicit_mask(mesh_seq8):
     fn = make_attention_fn(mesh_seq8)
     with pytest.raises(NotImplementedError):
         fn(q, k, v, mask=jnp.ones((1, 1, 32, 32), bool))
+
+
+def test_sliding_window_matches_dense_band(mesh_seq8):
+    """window=W across ring hops == dense attention under the causal band
+    (ADVICE r3: adapters must accept the layer's window= kwarg)."""
+    from distributed_deep_learning_tpu.models.transformer import (
+        dot_product_attention)
+
+    q, k, v = _qkv(seed=7)
+    for W in (3, 8, 17):
+        expected = dot_product_attention(q, k, v, causal=True, window=W)
+        got = ring_attention(q, k, v, mesh=mesh_seq8, causal=True, window=W)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   rtol=1e-5, atol=1e-5,
+                                   err_msg=f"window={W}")
+
+
+def test_sliding_window_requires_causal(mesh_seq8):
+    q, k, v = _qkv(seed=8)
+    with pytest.raises(ValueError, match="causal"):
+        ring_attention(q, k, v, mesh=mesh_seq8, window=4)
+
+
+def test_windowed_layer_through_adapter(mesh_seq8):
+    """MultiHeadAttention(window=W, attention_fn=ring adapter) must trace
+    and match the dense path (the r3 TypeError regression)."""
+    from distributed_deep_learning_tpu.models.transformer import (
+        MultiHeadAttention)
+    from distributed_deep_learning_tpu.parallel.ring_attention import (
+        make_attention_fn)
+
+    x = jax.random.normal(jax.random.key(9), (2, 32, 64))
+    dense = MultiHeadAttention(num_heads=4, window=4)
+    ringy = MultiHeadAttention(num_heads=4, window=4,
+                               attention_fn=make_attention_fn(mesh_seq8))
+    params = dense.init(jax.random.key(0), x, x, causal=True)
+    with mesh_seq8:
+        got = jax.jit(lambda p, x: ringy.apply(p, x, x, causal=True))(
+            params, x)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(dense.apply(params, x, x, causal=True)),
+        rtol=2e-4, atol=1e-5)

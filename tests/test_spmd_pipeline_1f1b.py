@@ -194,3 +194,69 @@ def test_pipeline_mode_elastic_recovers(tmp_path, monkeypatch):
     _, history = run_workload(BERT_SPEC, config)
     assert calls["n"] == 2
     assert "test" in [h.phase for h in history]
+
+
+class DropBlock(nn.Module):
+    """Residual block with real flax Dropout — the stochastic stage the
+    dropout-under-1F1B tests pipeline."""
+
+    @nn.compact
+    def __call__(self, h, train: bool = False):
+        h2 = nn.Dense(D, kernel_init=nn.initializers.lecun_normal())(
+            nn.relu(h))
+        h2 = nn.Dropout(0.5, deterministic=not train)(h2)
+        return h + h2
+
+
+def test_1f1b_dropout_matches_sequential_replay():
+    """VERDICT r3 item 5: --dropout under 1F1B.  The pipeline derives
+    key = fold_in(fold_in(rng, stage), mb) for forward AND the
+    rematerialised backward; a hand-rolled sequential replay with the
+    same keys must reproduce loss and gradients exactly."""
+    mesh = build_mesh({"stage": S}, jax.devices()[:S])
+    blk = DropBlock()
+    key = jax.random.key(0)
+    h0 = jnp.zeros((1, D))
+    trunk = stack_stage_params(
+        [blk.init(jax.random.fold_in(key, i), h0)["params"]
+         for i in range(S)])
+    head = nn.Dense(8)
+    x = jax.random.normal(jax.random.key(1), (16, D))
+    y = jax.nn.one_hot(jax.random.randint(jax.random.key(2), (16,), 0, 8), 8)
+    head_params = head.init(jax.random.key(3), x)["params"]
+    rng = jax.random.key(7)
+    stage_fn = lambda p, a, k: blk.apply(  # noqa: E731
+        {"params": p}, a, train=True, rngs={"dropout": k})
+
+    def head_loss(hp, h, tgt):
+        logits = head.apply({"params": hp}, h)
+        return jnp.mean(optax.softmax_cross_entropy(logits, tgt))
+
+    with mesh:
+        loss, tg, hg, dx = jax.jit(
+            lambda t, hp, x, y: spmd_pipeline_1f1b(
+                stage_fn, head_loss, t, hp, x, y, mesh=mesh,
+                microbatch_size=4, rng=rng))(trunk, head_params, x, y)
+
+    M, mb = 4, 4
+
+    def ref_loss(trunk, hp, x):
+        total = 0.0
+        for m in range(M):
+            h = x[m * mb:(m + 1) * mb]
+            for s in range(S):
+                p = jax.tree.map(lambda l, s=s: l[s], trunk)
+                h = stage_fn(p, h, jax.random.fold_in(
+                    jax.random.fold_in(rng, s), m))
+            total = total + head_loss(hp, h, y[m * mb:(m + 1) * mb])
+        return total / M
+
+    ref, (rtg, rhg, rdx) = jax.value_and_grad(
+        ref_loss, argnums=(0, 1, 2))(trunk, head_params, x)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6), tg, rtg)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6), hg, rhg)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(rdx),
+                               rtol=2e-4, atol=1e-6)

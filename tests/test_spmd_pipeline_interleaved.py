@@ -220,3 +220,135 @@ def test_interleaved_matches_sequential_many_microbatches(setup):
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5), tg, ref_tg)
+
+
+@pytest.mark.parametrize("M,Sp,Vp", [(2, 2, 2), (4, 2, 2), (8, 2, 2),
+                                     (4, 2, 3), (6, 3, 2), (8, 4, 2),
+                                     (8, 2, 4), (12, 4, 3)])
+def test_comm_double_buffers_never_clobbered(M, Sp, Vp):
+    """ADVICE r3: the executor parks ppermute arrivals in 2-deep
+    microbatch-parity buffers BEFORE the tick's compute reads them.
+    Replay every arrival/consume against (chunk, parity) slots and assert
+    no unconsumed activation or cotangent is ever overwritten — the
+    invariant the schedule's max_in_flight flow control (including the
+    same-tick last-stage backward append) must guarantee."""
+    from distributed_deep_learning_tpu.parallel.spmd_pipeline import (
+        _schedule_tables)
+
+    tbl = _schedule_tables(M, Sp, Vp)
+    L = Sp * Vp
+    fbuf: dict = {}  # (v, parity) -> microbatch whose activation is parked
+    bbuf: dict = {}
+    for t in range(tbl["n_ticks"]):
+        # arrivals land first (executor order: park, then compute)
+        for s in range(Sp):
+            c, m = tbl["fin_chunk"][t, s], tbl["fin_mb"][t, s]
+            if c >= 0:
+                key = (c * Sp + s, m % 2)
+                assert key not in fbuf, \
+                    f"fbuf slot {key} clobbered at t={t}: " \
+                    f"held mb {fbuf[key]}, arriving mb {m}"
+                fbuf[key] = m
+            c, m = tbl["bin_chunk"][t, s], tbl["bin_mb"][t, s]
+            if c >= 0:
+                key = (c * Sp + s, m % 2)
+                assert key not in bbuf, \
+                    f"bbuf slot {key} clobbered at t={t}: " \
+                    f"held mb {bbuf[key]}, arriving mb {m}"
+                bbuf[key] = m
+        # compute consumes
+        for s in range(Sp):
+            fc, fm = tbl["f_chunk"][t, s], tbl["f_mb"][t, s]
+            if fc >= 0:
+                v = fc * Sp + s
+                if v > 0:  # virtual stage 0 microbatch reads xs directly
+                    key = (v, fm % 2)
+                    assert fbuf.get(key) == fm, \
+                        f"F at t={t} read fbuf {key}: wanted {fm}, " \
+                        f"held {fbuf.get(key)}"
+                    del fbuf[key]
+            bc, bm = tbl["b_chunk"][t, s], tbl["b_mb"][t, s]
+            if bc >= 0:
+                v = bc * Sp + s
+                if v < L - 1:  # last virtual stage seeds from the head
+                    key = (v, bm % 2)
+                    assert bbuf.get(key) == bm, \
+                        f"B at t={t} read bbuf {key}: wanted {bm}, " \
+                        f"held {bbuf.get(key)}"
+                    del bbuf[key]
+    assert not fbuf and not bbuf
+
+
+def test_interleaved_dropout_matches_sequential_replay():
+    """VERDICT r3 item 5: --dropout under the interleaved schedule.  Keys
+    are derived per GLOBAL virtual stage v = c*S + s and microbatch; a
+    sequential replay with the same keys must agree exactly."""
+    import flax.linen as nn
+    import optax
+
+    from distributed_deep_learning_tpu.parallel.spmd_pipeline import (
+        spmd_pipeline_interleaved, stack_stage_params)
+    from distributed_deep_learning_tpu.runtime.mesh import build_mesh
+
+    Sp, Vp, D = 2, 2, 16
+    L = Sp * Vp
+
+    class DropBlock(nn.Module):
+        @nn.compact
+        def __call__(self, h, train: bool = False):
+            h2 = nn.Dense(D, kernel_init=nn.initializers.lecun_normal())(
+                nn.relu(h))
+            h2 = nn.Dropout(0.5, deterministic=not train)(h2)
+            return h + h2
+
+    mesh = build_mesh({"stage": Sp}, jax.devices()[:Sp])
+    blk = DropBlock()
+    key = jax.random.key(0)
+    h0 = jnp.zeros((1, D))
+    flat = stack_stage_params(
+        [blk.init(jax.random.fold_in(key, i), h0)["params"]
+         for i in range(L)])   # index v = c*Sp + s
+    stacked = jax.tree.map(
+        lambda l: l.reshape(Vp, Sp, *l.shape[1:]), flat)
+    head = nn.Dense(8)
+    x = jax.random.normal(jax.random.key(1), (16, D))
+    y = jax.nn.one_hot(jax.random.randint(jax.random.key(2), (16,), 0, 8),
+                       8)
+    head_params = head.init(jax.random.key(3), x)["params"]
+    rng = jax.random.key(11)
+    stage_fn = lambda p, a, k: blk.apply(  # noqa: E731
+        {"params": p}, a, train=True, rngs={"dropout": k})
+
+    def head_loss(hp, h, tgt):
+        logits = head.apply({"params": hp}, h)
+        return jnp.mean(optax.softmax_cross_entropy(logits, tgt))
+
+    with mesh:
+        loss, tg, hg, dx = jax.jit(
+            lambda t, hp, x, y: spmd_pipeline_interleaved(
+                stage_fn, head_loss, t, hp, x, y, mesh=mesh,
+                microbatch_size=4, rng=rng))(stacked, head_params, x, y)
+
+    M, mb = 4, 4
+
+    def ref_loss(flat, hp, x):
+        total = 0.0
+        for m in range(M):
+            h = x[m * mb:(m + 1) * mb]
+            for v in range(L):
+                p = jax.tree.map(lambda l, v=v: l[v], flat)
+                h = stage_fn(p, h, jax.random.fold_in(
+                    jax.random.fold_in(rng, v), m))
+            total = total + head_loss(hp, h, y[m * mb:(m + 1) * mb])
+        return total / M
+
+    ref, (rtg_flat, rhg, rdx) = jax.value_and_grad(
+        ref_loss, argnums=(0, 1, 2))(flat, head_params, x)
+    rtg = jax.tree.map(lambda l: l.reshape(Vp, Sp, *l.shape[1:]), rtg_flat)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6), tg, rtg)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6), hg, rhg)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(rdx),
+                               rtol=2e-4, atol=1e-6)

@@ -125,3 +125,41 @@ def test_indivisible_sequence_raises(mesh_seq8):
     q, k, v = _qkv(T=30)
     with pytest.raises(ValueError, match="sequence length"):
         ulysses_attention(q, k, v, mesh=mesh_seq8)
+
+
+def test_sliding_window_matches_dense_band(mesh_seq8):
+    """window= forwards through the all-to-all to the local kernel
+    (ADVICE r3: adapters must accept the layer's window= kwarg)."""
+    from distributed_deep_learning_tpu.models.transformer import (
+        dot_product_attention)
+
+    q, k, v = _qkv(seed=9)
+    for W in (3, 8):
+        expected = dot_product_attention(q, k, v, causal=True, window=W)
+        with mesh_seq8:
+            got = ulysses_attention(q, k, v, mesh=mesh_seq8, causal=True,
+                                    window=W)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   rtol=1e-5, atol=1e-5,
+                                   err_msg=f"window={W}")
+
+
+def test_windowed_layer_through_adapter_flash_inner(mesh_seq8):
+    """window= through MultiHeadAttention -> ulysses adapter -> flash inner:
+    the full default-TPU composition that r3 left untested."""
+    from distributed_deep_learning_tpu.models.transformer import (
+        MultiHeadAttention)
+    from distributed_deep_learning_tpu.ops import attention_pallas
+
+    x = jax.random.normal(jax.random.key(10), (2, 32, 64))
+    inner = attention_pallas.make_attention_fn(block_q=8, block_k=8)
+    dense = MultiHeadAttention(num_heads=8, window=4)
+    sp = MultiHeadAttention(num_heads=8, window=4,
+                            attention_fn=make_attention_fn(mesh_seq8,
+                                                           inner=inner))
+    params = dense.init(jax.random.key(0), x, x, causal=True)
+    with mesh_seq8:
+        got = jax.jit(lambda p, x: sp.apply(p, x, x, causal=True))(params, x)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(dense.apply(params, x, x, causal=True)),
+        rtol=2e-4, atol=2e-5)
