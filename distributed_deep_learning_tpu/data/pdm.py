@@ -33,6 +33,11 @@ class PdMWindowedDataset:
                  history: int = 10, instances_per_machine: int = 8759):
         if len(features) != len(targets):
             raise ValueError("features/targets length mismatch")
+        if instances_per_machine < history:  # == history: one full window
+            raise ValueError(
+                f"instances_per_machine={instances_per_machine} is shorter "
+                f"than history={history}: each machine needs at least one "
+                "full window")
         if len(features) % instances_per_machine:
             raise ValueError(
                 f"{len(features)} rows not divisible by instances_per_machine "
@@ -81,8 +86,11 @@ def load_pdm(path: str = "/data/PredictiveMaintenance/dataset.csv",
     from distributed_deep_learning_tpu import native
 
     data = native.read_csv(path, skip_header=True)
+    ipm = len(data) if instances_per_machine is None \
+        else instances_per_machine  # 0 is an error, not "one machine";
+    # ipm-vs-history validation lives in PdMWindowedDataset.__init__
     return PdMWindowedDataset(
         np.ascontiguousarray(data[:, :-NUM_TARGETS]),
         np.ascontiguousarray(data[:, -NUM_TARGETS:]),
         history=history,
-        instances_per_machine=instances_per_machine or len(data))
+        instances_per_machine=ipm)

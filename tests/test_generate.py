@@ -273,12 +273,14 @@ def test_gpt_generate_too_long_rejected_before_training():
 
     from distributed_deep_learning_tpu.workloads.northstar import (
         _gpt_pre_check)
+    from distributed_deep_learning_tpu.utils.config import Mode
 
     class DS:
         features = np.zeros((4, 64), np.int32)
 
     class Cfg:
         generate_tokens = 56
+        mode = Mode.DATA
     _gpt_pre_check(Cfg(), DS())  # 8 + 56 == 64: fits
 
     Cfg.generate_tokens = 57

@@ -165,6 +165,35 @@ def test_pdm_missing_file_raises():
         load_pdm("/nonexistent/dataset.csv")
 
 
+def test_pdm_instances_per_machine_validation(tmp_path):
+    """ADVICE r4: an explicit 0 must be an error, not 'one machine'; and
+    ipm == history (exactly one window per machine) stays valid."""
+    from distributed_deep_learning_tpu.data.pdm import load_pdm
+
+    history = 10
+    csv = tmp_path / "pdm.csv"
+    header = ",".join(f"c{i}" for i in range(9))
+    rows = [",".join(f"{r + c / 10:.1f}" for c in range(9))
+            for r in range(history)]
+    csv.write_text("\n".join([header] + rows) + "\n")
+
+    with pytest.raises(ValueError, match="shorter than history"):
+        load_pdm(str(csv), history=history, instances_per_machine=0)
+    with pytest.raises(ValueError, match="shorter than history"):
+        load_pdm(str(csv), history=history, instances_per_machine=history - 1)
+    # the guard lives in __init__, so direct constructions are covered too
+    from distributed_deep_learning_tpu.data.pdm import PdMWindowedDataset
+    with pytest.raises(ValueError, match="shorter than history"):
+        PdMWindowedDataset(np.zeros((5, 4), np.float32),
+                           np.zeros((5, 5), np.float32),
+                           history=history, instances_per_machine=5)
+    # exactly one full window per machine: valid (off-by-one guard)
+    ds = load_pdm(str(csv), history=history, instances_per_machine=history)
+    assert len(ds) == 1
+    ds_none = load_pdm(str(csv), history=history, instances_per_machine=None)
+    assert len(ds_none) == 1
+
+
 def test_pcb_missing_dir_raises():
     from distributed_deep_learning_tpu.data.pcb import PCBDataset
 
