@@ -38,26 +38,40 @@ NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() well-defined
 
 
 def _block_attention(q, k, v, m, l, acc, q_start, k_start, causal,
-                     window=None):
+                     window=None, key_valid=None):
     """Fold one visiting K/V block into the online-softmax accumulator.
 
-    Shapes: q (B,H,Tq,D); k,v (B,H,Tk,D); m,l (B,H,Tq); acc (B,H,Tq,D).
+    Shapes: q (B,H,Tq,D); k,v (B,H,Tk,D); m,l (B,H,Tq); acc (B,H,Tq,D);
+    ``key_valid`` (B,Tk) bools for the VISITING key block (padding mask).
     ``q_start``/``k_start`` are the blocks' global sequence offsets (for the
     causal / sliding-window mask across blocks).
     """
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(q.shape[-1] * 1.0)
+    mask = None
     if causal:
         q_pos = q_start + jnp.arange(q.shape[2])
         k_pos = k_start + jnp.arange(k.shape[2])
-        mask = q_pos[:, None] >= k_pos[None, :]
+        mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
         if window is not None:
-            mask = jnp.logical_and(mask,
-                                   q_pos[:, None] - k_pos[None, :] < window)
-        scores = jnp.where(mask[None, None], scores, NEG_INF)
+            mask = jnp.logical_and(
+                mask, (q_pos[:, None] - k_pos[None, :] < window)[None, None])
+    if key_valid is not None:
+        kvm = key_valid[:, None, None, :]  # (B,1,1,Tk)
+        mask = kvm if mask is None else jnp.logical_and(mask, kvm)
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
     block_max = jnp.max(scores, axis=-1)
     new_m = jnp.maximum(m, block_max)
     correction = jnp.exp(m - new_m)
     p = jnp.exp(scores - new_m[..., None])
+    if key_valid is not None:
+        # explicit zeroing: for a query row whose every key so far is
+        # invalid, new_m == NEG_INF and exp(scores - new_m) == exp(0) == 1
+        # — the exp trick alone would count masked keys.  Only key_valid
+        # can produce such rows (hop 0's diagonal block makes new_m finite
+        # on the pure-causal path, where exp already underflows to 0.0),
+        # so the causal fast path skips this multiply.
+        p = p * mask
     new_l = l * correction + jnp.sum(p, axis=-1)
     new_acc = acc * correction[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
     return new_m, new_l, new_acc
@@ -66,6 +80,7 @@ def _block_attention(q, k, v, m, l, acc, q_start, k_start, causal,
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                    mesh: Mesh, axis: str = "seq", causal: bool = False,
                    window: int | None = None,
+                   key_valid: jnp.ndarray | None = None,
                    batch_axes: tuple[str, ...] = ("data", "fsdp")
                    ) -> jnp.ndarray:
     """Exact multi-head attention with the sequence sharded over ``axis``.
@@ -81,6 +96,14 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         global-position arithmetic as the causal mask; the hop-0 diagonal
         block guarantees every query row folds at least its own position
         first, so later fully-masked blocks contribute exp(-inf)=0.
+      key_valid: optional ``(B, T)`` boolean padding mask (True = key may
+        be attended), sharded over ``axis`` like K.  Each device's
+        validity block RIDES THE RING with its K/V block (one extra
+        ppermute of B·T/S bools per hop) so every hop masks the visiting
+        keys exactly as the dense path would.  A query row with no valid
+        key anywhere (a pad query under causal+padding) returns zeros —
+        finite, so downstream layers and grads stay NaN-free; the loss
+        masks such rows anyway.
 
     Returns ``(B, T, H, D)`` attention output, sharded like ``q``.
     """
@@ -89,14 +112,21 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                          "causal=True")
     S = mesh.shape[axis]
     B, T, H, D = q.shape
-    if T % S:
-        raise ValueError(f"sequence length {T} not divisible by {axis}={S}")
+    Tk = k.shape[1]
+    if T % S or Tk % S:
+        raise ValueError(f"sequence lengths q={T}, k={Tk} must divide "
+                         f"{axis}={S}")
+    has_kv = key_valid is not None
+    if has_kv and key_valid.shape != (B, Tk):
+        raise ValueError(f"key_valid shape {key_valid.shape} != ({B}, {Tk})")
 
     spec = P(batch_axes, axis, None, None)
+    kv_spec = P(batch_axes, axis)
+    in_specs = (spec, spec, spec) + ((kv_spec,) if has_kv else ())
 
-    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+    @partial(shard_map, mesh=mesh, in_specs=in_specs,
              out_specs=spec, check_vma=False)
-    def run(q, k, v):
+    def run(q, k, v, *maybe_kv):
         # local blocks: (B', Tl, H, D) → (B', H, Tl, D)
         q_ = jnp.swapaxes(q, 1, 2)
         k_ = jnp.swapaxes(k, 1, 2)
@@ -109,46 +139,62 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         l0 = jnp.zeros(q_.shape[:3], q_.dtype)
         acc0 = jnp.zeros_like(q_)
         perm = [(i, (i + 1) % S) for i in range(S)]
+        kv0 = maybe_kv[0] if has_kv else jnp.zeros((), q_.dtype)  # carry stub
+
+        Tkl = k_.shape[2]  # cross-attention: K's block length, not Q's
 
         def hop(carry, r):
-            k_blk, v_blk, m, l, acc = carry
+            k_blk, v_blk, kv_blk, m, l, acc = carry
             # the block visiting at hop r originated on device (my - r) mod S
-            k_start = ((my - r) % S) * Tl
-            m, l, acc = _block_attention(q_, k_blk, v_blk, m, l, acc,
-                                         q_start, k_start, causal, window)
+            k_start = ((my - r) % S) * Tkl
+            m, l, acc = _block_attention(
+                q_, k_blk, v_blk, m, l, acc, q_start, k_start, causal,
+                window, key_valid=kv_blk if has_kv else None)
             k_blk = lax.ppermute(k_blk, axis, perm)
             v_blk = lax.ppermute(v_blk, axis, perm)
-            return (k_blk, v_blk, m, l, acc), None
+            if has_kv:
+                kv_blk = lax.ppermute(kv_blk, axis, perm)
+            return (k_blk, v_blk, kv_blk, m, l, acc), None
 
-        (_, _, m, l, acc), _ = lax.scan(
-            hop, (k_, v_, m0, l0, acc0), jnp.arange(S))
-        out = acc / l[..., None]
+        (_, _, _, m, l, acc), _ = lax.scan(
+            hop, (k_, v_, kv0, m0, l0, acc0), jnp.arange(S))
+        if has_kv:
+            # guarded division: all-keys-invalid rows have l == 0 → 0 out
+            out = jnp.where(l[..., None] > 0,
+                            acc / jnp.maximum(l, 1e-30)[..., None], 0.0)
+        else:
+            out = acc / l[..., None]
         return jnp.swapaxes(out, 1, 2)
 
-    return run(q, k, v)
+    return run(q, k, v, *((key_valid,) if has_kv else ()))
 
 
-def make_attention_fn(mesh: Mesh, axis: str = "seq", causal: bool = False):
+def make_attention_fn(mesh: Mesh, axis: str = "seq", causal: bool = False,
+                      batch_axes: tuple[str, ...] = ("data", "fsdp")):
     """Adapter: ring attention as a ``MultiHeadAttention.attention_fn``.
 
     The causal mask is computed internally from global block positions (the
     (T×T) mask tensor the dense path builds would defeat the whole point),
     so pass ``causal=True`` HERE and leave the layer's ``causal=False``.
-    Arbitrary (padding) masks are not supported yet — pad to block
-    boundaries instead.
+    ``key_valid`` padding masks are supported (they ride the ring, VERDICT
+    r4 item 4); arbitrary pre-built dense ``mask`` tensors are not — a
+    global (T×T) mask is exactly what sequence sharding avoids.
     """
 
     forced_causal = causal
 
     def attn(q, k, v, *, mask=None, key_valid=None, causal=False,
              window=None, dtype=jnp.float32):
-        if mask is not None or key_valid is not None:
+        if mask is not None:
             raise NotImplementedError(
-                "ring attention computes its causal mask internally from "
-                "global positions; explicit mask tensors are unsupported "
-                "(pad to block boundaries instead)")
+                "ring attention computes masks internally from global "
+                "positions (causal=...) and per-key validity "
+                "(key_valid=...); arbitrary dense mask tensors are "
+                "unsupported — a global (T, T) mask defeats sequence "
+                "sharding")
         out = ring_attention(q, k, v, mesh=mesh, axis=axis,
-                             causal=causal or forced_causal, window=window)
+                             causal=causal or forced_causal, window=window,
+                             key_valid=key_valid, batch_axes=batch_axes)
         return out.astype(dtype)
 
     return attn

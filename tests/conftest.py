@@ -41,3 +41,11 @@ def mesh8():
 def mesh_4x2():
     from distributed_deep_learning_tpu.runtime.mesh import build_mesh
     return build_mesh({"data": 4, "stage": 2})
+
+
+def padded_valid(T=32, lengths=(20, 32)):
+    """(len(lengths), T) bool key_valid with ragged True prefixes — the
+    shared padded-batch fixture for the SP/flash parity suites."""
+    import jax.numpy as jnp
+
+    return jnp.arange(T)[None, :] < jnp.array(lengths)[:, None]

@@ -114,11 +114,101 @@ def test_layer_adapter(mesh_seq8):
                                rtol=2e-4, atol=2e-5)
 
 
-def test_adapter_rejects_masks(mesh_seq8):
+def test_adapter_rejects_dense_masks_only(mesh_seq8):
+    """key_valid now threads through (VERDICT r4 item 4); only arbitrary
+    dense mask tensors stay rejected."""
     fn = make_attention_fn(mesh_seq8)
     q, k, v = _qkv(seed=6)
     with pytest.raises(NotImplementedError):
-        fn(q, k, v, key_valid=jnp.ones((2, 32), bool))
+        fn(q, k, v, mask=jnp.ones((1, 1, 32, 32), bool))
+    with mesh_seq8:
+        out = fn(q, k, v, key_valid=jnp.ones((2, 32), bool))
+    assert out.shape == q.shape
+
+
+from conftest import padded_valid as _padded_valid
+
+
+def test_key_valid_matches_dense_masked(mesh_seq8):
+    """Padding masks through the all-to-all: parity with the dense masked
+    path on a padded batch, causal and not."""
+    from distributed_deep_learning_tpu.models.transformer import (
+        dot_product_attention)
+
+    q, k, v = _qkv(seed=7)
+    valid = _padded_valid()
+    for causal in (False, True):
+        expected = dot_product_attention(q, k, v, key_valid=valid,
+                                         causal=causal)
+        with mesh_seq8:
+            got = jax.jit(lambda q, k, v: ulysses_attention(
+                q, k, v, mesh=mesh_seq8, causal=causal,
+                key_valid=valid))(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   rtol=2e-5, atol=2e-5,
+                                   err_msg=f"causal={causal}")
+
+
+def test_key_valid_flash_inner(mesh_seq8):
+    """key_valid reaches the Pallas flash inner kernel — the full padded
+    default-TPU composition."""
+    from distributed_deep_learning_tpu.models.transformer import (
+        dot_product_attention)
+    from distributed_deep_learning_tpu.ops import attention_pallas
+
+    q, k, v = _qkv(seed=8)
+    valid = _padded_valid()
+    inner = attention_pallas.make_attention_fn(block_q=8, block_k=8)
+    expected = dot_product_attention(q, k, v, key_valid=valid, causal=True)
+    with mesh_seq8:
+        got = jax.jit(lambda q, k, v: ulysses_attention(
+            q, k, v, mesh=mesh_seq8, causal=True, key_valid=valid,
+            attention_fn=inner))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_key_valid_gradients_match(mesh_seq8):
+    from distributed_deep_learning_tpu.models.transformer import (
+        dot_product_attention)
+
+    q, k, v = _qkv(seed=11)
+    valid = _padded_valid()
+    w = valid[:, :, None, None].astype(q.dtype)
+
+    def loss_u(q, k, v):
+        out = ulysses_attention(q, k, v, mesh=mesh_seq8, causal=True,
+                                key_valid=valid)
+        return jnp.sum((out * w) ** 2)
+
+    def loss_d(q, k, v):
+        out = dot_product_attention(q, k, v, key_valid=valid, causal=True)
+        return jnp.sum((out * w) ** 2)
+
+    with mesh_seq8:
+        gu = jax.jit(jax.grad(loss_u, argnums=(0, 1, 2)))(q, k, v)
+    gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gu, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_key_valid_cross_length(mesh_seq8):
+    """Tq != Tk with a padded source (the WMT decoder's cross-attention)."""
+    from distributed_deep_learning_tpu.models.transformer import (
+        dot_product_attention)
+
+    ks = jax.random.split(jax.random.key(13), 3)
+    q = jax.random.normal(ks[0], (2, 16, 8, 16))
+    k = jax.random.normal(ks[1], (2, 32, 8, 16))
+    v = jax.random.normal(ks[2], (2, 32, 8, 16))
+    valid = _padded_valid(T=32, lengths=(20, 32))
+    expected = dot_product_attention(q, k, v, key_valid=valid)
+    with mesh_seq8:
+        got = jax.jit(lambda q, k, v: ulysses_attention(
+            q, k, v, mesh=mesh_seq8, key_valid=valid))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
 
 
 def test_indivisible_sequence_raises(mesh_seq8):

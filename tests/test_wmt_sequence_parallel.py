@@ -1,0 +1,81 @@
+"""The WMT seq2seq workload (BASELINE.json configs[3]) under sequence
+parallelism on PADDED batches — the composition VERDICT r4 item 4 flagged:
+ring/Ulysses must serve the framework's own flagship seq model with real
+variable-length data (synthetic_wmt pads rows to src/tgt_len with 0s).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_deep_learning_tpu.data.datasets import synthetic_wmt
+from distributed_deep_learning_tpu.models.transformer import (
+    TransformerSeq2Seq)
+from distributed_deep_learning_tpu.parallel import ring_attention, ulysses
+from distributed_deep_learning_tpu.runtime.mesh import build_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh_seq8():
+    return build_mesh({"seq": 8})
+
+
+@pytest.fixture(scope="module")
+def wmt_batch():
+    ds = synthetic_wmt(n=4, src_len=32, tgt_len=32, vocab_size=64, seed=3)
+    batch = {"inputs": jnp.asarray(ds.features),
+             "targets": jnp.asarray(ds.targets)}
+    assert (np.asarray(ds.features) == 0).any(), "fixture must be padded"
+    return batch
+
+
+def _model(attention_fn=None):
+    return TransformerSeq2Seq(vocab_size=64, num_layers=2, d_model=32,
+                              num_heads=8, mlp_dim=64, dropout_rate=0.0,
+                              attention_fn=attention_fn)
+
+
+def _loss(model, params, batch):
+    """Mean CE over non-pad target positions (the padded-loss convention)."""
+    logits = model.apply(params, batch)
+    valid = (batch["targets"] != 0).astype(jnp.float32)
+    ll = jax.nn.log_softmax(logits)
+    ce = -jnp.take_along_axis(ll, batch["targets"][..., None],
+                              axis=-1)[..., 0]
+    return jnp.sum(ce * valid) / jnp.sum(valid)
+
+
+@pytest.mark.parametrize("scheme", ["ring", "ulysses"])
+def test_wmt_padded_forward_parity(mesh_seq8, wmt_batch, scheme):
+    """Same params, dense vs sequence-parallel attention: logits match on
+    the padded WMT batch (enc self / dec causal self / cross, all with
+    key_valid threading through the seq axis)."""
+    adapter = (ring_attention if scheme == "ring" else ulysses) \
+        .make_attention_fn(mesh_seq8)
+    dense = _model()
+    sp = _model(attention_fn=adapter)
+    params = dense.init(jax.random.key(0), wmt_batch)
+    expected = dense.apply(params, wmt_batch)
+    with mesh_seq8:
+        got = jax.jit(lambda p, b: sp.apply(p, b))(params, wmt_batch)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=5e-4, atol=5e-4)
+
+
+def test_wmt_padded_train_step_parity(mesh_seq8, wmt_batch):
+    """One padded-loss gradient step under ring SP matches dense."""
+    dense = _model()
+    sp = _model(attention_fn=ring_attention.make_attention_fn(mesh_seq8))
+    params = dense.init(jax.random.key(0), wmt_batch)
+
+    ld, gd = jax.value_and_grad(lambda p: _loss(dense, p, wmt_batch))(params)
+    with mesh_seq8:
+        ls, gs = jax.jit(jax.value_and_grad(
+            lambda p: _loss(sp, p, wmt_batch)))(params)
+    np.testing.assert_allclose(float(ls), float(ld), rtol=1e-4)
+    flat_d = jax.tree_util.tree_leaves(gd)
+    flat_s = jax.tree_util.tree_leaves(gs)
+    for a, b in zip(flat_s, flat_d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-4)
