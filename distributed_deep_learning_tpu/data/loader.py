@@ -82,8 +82,17 @@ class DeviceLoader:
         return jax.make_array_from_process_local_data(self._sharding, host)
 
     def __iter__(self) -> Iterator[tuple[jax.Array, jax.Array]]:
+        return self.iter_batches()
+
+    def iter_batches(self, skip: int = 0
+                     ) -> Iterator[tuple[jax.Array, jax.Array]]:
+        """Iterate the epoch's batches, optionally skipping the first
+        ``skip`` WITHOUT materialising them (mid-epoch resume: the skipped
+        batches were already trained before the checkpoint — no gather, no
+        decode, no device transfer for them)."""
         idx = self._epoch_indices()
-        for start in range(0, len(idx), self.global_batch_size):
+        for start in range(skip * self.global_batch_size, len(idx),
+                           self.global_batch_size):
             batch_idx = idx[start:start + self.global_batch_size]
             if len(batch_idx) < self.global_batch_size and self.drop_remainder:
                 break
@@ -116,7 +125,20 @@ class PrefetchLoader:
         if hasattr(self.loader, "set_epoch"):
             self.loader.set_epoch(epoch)
 
+    def iter_batches(self, skip: int = 0):
+        """Mid-epoch resume passthrough: skip inside the WRAPPED loader
+        (before materialisation) when it supports it, else drop the first
+        ``skip`` prefetched items."""
+        if hasattr(self.loader, "iter_batches"):
+            return self._pump(self.loader.iter_batches(skip))
+        import itertools
+
+        return itertools.islice(self._pump(iter(self.loader)), skip, None)
+
     def __iter__(self):
+        return self._pump(iter(self.loader))
+
+    def _pump(self, source):
         import queue
         import threading
 
@@ -137,7 +159,7 @@ class PrefetchLoader:
 
         def produce():
             try:
-                for item in self.loader:
+                for item in source:
                     if not put(item):
                         return
                 put(_END)

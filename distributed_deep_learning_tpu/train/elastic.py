@@ -8,9 +8,12 @@ losing the job.  The reference's failure model was "any rank failure hangs
 or kills the job" (SURVEY.md §5); this is the TPU-pod answer, where the
 scheduler restarting you is routine, not exceptional.
 
-The unit of recovery is the epoch (matching the checkpoint cadence of
-:func:`..loop.fit`); mid-epoch progress is repeated deterministically
-(seeded loaders), so a recovered run equals an uninterrupted one.
+The unit of recovery is the latest checkpoint: the epoch by default, or
+the last ``checkpoint_every`` step boundary when step-granular saves are
+on (round 5 — at ImageNet scale an epoch-level redo after preemption is
+hours).  Progress past the checkpoint is repeated deterministically
+(seeded loaders replay the epoch's batch order), so a recovered run
+equals an uninterrupted one bit for bit.
 """
 
 from __future__ import annotations
@@ -24,12 +27,35 @@ from distributed_deep_learning_tpu.utils.failures import (FailureMonitor,
 from distributed_deep_learning_tpu.utils.logging import PhaseLogger
 
 
+def resume_point(checkpointer: Checkpointer
+                 ) -> tuple[int | None, int, int, dict | None]:
+    """Decode the latest checkpoint into a resume point.
+
+    Returns ``(ckpt_step, start_epoch, resume_batch, resume_totals)``:
+    ``ckpt_step`` is the orbax id to restore (None = start fresh);
+    ``resume_batch > 0`` means mid-epoch — skip that many batches of
+    ``start_epoch`` and seed the phase totals with ``resume_totals``.
+    Sidecar-less checkpoints (pre-round-5 run dirs) keep the legacy
+    convention step == completed epoch."""
+    last = checkpointer.latest_step()
+    if last is None:
+        return None, 1, 0, None
+    extra = checkpointer.read_extra(last)
+    if extra is None:  # legacy epoch-id checkpoint
+        return last, last + 1, 0, None
+    if extra.get("epoch_complete"):
+        return last, int(extra["epoch"]) + 1, 0, None
+    return last, int(extra["epoch"]), int(extra["batch"]), \
+        extra.get("totals")
+
+
 def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
                       loaders: Sequence, epochs: int,
                       checkpointer: Checkpointer, *,
                       logger: PhaseLogger | None = None,
                       monitor: FailureMonitor | None = None,
-                      max_restarts: int = 2
+                      max_restarts: int = 2,
+                      checkpoint_every: int | None = None
                       ) -> tuple[Any, list[EpochResult]]:
     """Run :func:`..loop.fit` with checkpointed restart on failure.
 
@@ -38,16 +64,23 @@ def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
     attempt are never reused).  Failures caught: :class:`WorkerFailure`
     from the monitor and runtime errors surfaced by JAX; after
     ``max_restarts`` recoveries the last error propagates.
+    ``checkpoint_every=N`` saves every N train steps and recovers from the
+    last step boundary (loader position rides the checkpoint sidecar).
     """
     logger = logger or PhaseLogger(verbose=False)
     train_loader, val_loader, test_loader = loaders
     restarts = 0
     while True:
         state = make_state()
-        last = checkpointer.latest_step()
-        if last is not None:
-            state = checkpointer.restore(state) or state
-        start_epoch = (last or 0) + 1
+        # flush in-flight async saves BEFORE reading the resume point: a
+        # step save scheduled just before the failure must be visible to
+        # this retry, or it would resume from an older boundary and try to
+        # re-save an id that then finalises under it (review finding)
+        checkpointer.wait_until_finished()
+        ckpt_step, start_epoch, resume_batch, resume_totals = \
+            resume_point(checkpointer)
+        if ckpt_step is not None:
+            state = checkpointer.restore(state, step=ckpt_step) or state
         try:
             if monitor is not None:
                 monitor.raise_if_failed()
@@ -58,11 +91,14 @@ def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
             return fit(state, train_step, eval_step, train_loader,
                        val_loader, test_loader, epochs=epochs, logger=logger,
                        checkpointer=checkpointer, start_epoch=start_epoch,
-                       monitor=monitor)
+                       monitor=monitor, checkpoint_every=checkpoint_every,
+                       resume_batch=resume_batch,
+                       resume_totals=resume_totals)
         except (WorkerFailure, RuntimeError) as e:
             restarts += 1
             if restarts > max_restarts:
                 raise
+            _, ep, b, _ = resume_point(checkpointer)
+            at = f"epoch {ep} step {b}" if b else f"epoch {ep}"
             logger.info(f"recovering from failure ({type(e).__name__}: {e}); "
-                        f"restart {restarts}/{max_restarts} from epoch "
-                        f"{checkpointer.latest_step() or 0}")
+                        f"restart {restarts}/{max_restarts} from {at}")

@@ -36,10 +36,38 @@ class EpochResult:
         return self.examples / self.seconds if self.seconds > 0 else 0.0
 
 
-def _run_phase(step_fn, state, loader, *, train: bool, monitor=None):
-    """Drive one phase; returns (state, totals) with one host sync at end."""
+def _sum_totals(device_metrics, init_totals=None):
+    """Host-sync and sum the per-step metric dicts (+ restored partials)."""
+    if device_metrics:
+        totals = jax.tree.map(
+            lambda *xs: np.sum(jax.device_get(list(xs)), axis=0),
+            *device_metrics)
+    else:
+        totals = {"loss": 0.0, "correct": 0, "count": 0}
+    if init_totals:
+        totals = {k: totals.get(k, 0) + init_totals[k] for k in init_totals}
+    return totals
+
+
+def _run_phase(step_fn, state, loader, *, train: bool, monitor=None,
+               skip: int = 0, init_totals=None, on_step=None):
+    """Drive one phase; returns (state, totals) with one host sync at end.
+
+    ``skip`` batches are consumed-but-not-trained (mid-epoch resume: the
+    seeded loader replays the epoch's batch order; the first ``skip`` were
+    already folded into the restored state and ``init_totals``).
+    ``on_step(batch_idx, state, totals_fn)`` fires after every trained
+    step — the step-checkpoint/chaos hook; ``totals_fn()`` materialises
+    the running totals only when actually needed (a save), keeping the
+    per-step path sync-free."""
     device_metrics = []
-    for x, y in loader:
+    if skip and hasattr(loader, "iter_batches"):
+        batches = loader.iter_batches(skip)  # skipped without materialising
+    else:
+        import itertools
+
+        batches = itertools.islice(iter(loader), skip, None)
+    for i, (x, y) in enumerate(batches, start=skip):
         if monitor is not None:
             # cheap per-step liveness poll (an attribute read): a peer dying
             # mid-epoch surfaces HERE instead of hanging the next collective
@@ -49,11 +77,10 @@ def _run_phase(step_fn, state, loader, *, train: bool, monitor=None):
         else:
             m = step_fn(state, x, y)
         device_metrics.append(m)
-    if not device_metrics:
-        return state, {"loss": 0.0, "correct": 0, "count": 0}
-    summed = jax.tree.map(lambda *xs: np.sum(jax.device_get(list(xs)), axis=0),
-                          *device_metrics)
-    return state, summed
+        if on_step is not None:
+            on_step(i + 1, state,
+                    lambda: _sum_totals(device_metrics, init_totals))
+    return state, _sum_totals(device_metrics, init_totals)
 
 
 def _result(phase: str, epoch: int | None, totals, t0: float, t1: float) -> EpochResult:
@@ -70,7 +97,9 @@ def _result(phase: str, epoch: int | None, totals, t0: float, t1: float) -> Epoc
 
 def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
         test_loader, epochs: int, logger: PhaseLogger | None = None,
-        checkpointer=None, start_epoch: int = 1, monitor=None
+        checkpointer=None, start_epoch: int = 1, monitor=None,
+        checkpoint_every: int | None = None, resume_batch: int = 0,
+        resume_totals: dict | None = None
         ) -> tuple[TrainState, list[EpochResult]]:
     """Drive the epoch loop.  With a ``checkpointer``
     (:class:`..utils.checkpoint.Checkpointer`) the state is saved after
@@ -78,19 +107,70 @@ def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
     resume a preempted run.  ``monitor``
     (:class:`..utils.failures.FailureMonitor`) is polled before every step
     so a dead peer raises :class:`..utils.failures.WorkerFailure` promptly
-    instead of hanging the next collective."""
+    instead of hanging the next collective.
+
+    ``checkpoint_every=N`` additionally saves every N train steps with the
+    loader position and partial-phase totals in the sidecar, so a
+    preemption costs at most N steps, not an epoch (VERDICT r4 item 5/6:
+    at ImageNet scale an epoch-level redo is hours).  Step saves use
+    GLOBAL-step ids ``(epoch-1)*len(train_loader)+batch`` (epoch ids
+    without it, the legacy cadence).  ``resume_batch``/``resume_totals``
+    (from :meth:`Checkpointer.read_extra`) resume mid-epoch: the seeded
+    loader replays ``start_epoch``'s batch order and the first
+    ``resume_batch`` batches are skipped — continuation is bit-identical
+    to the uninterrupted run."""
     logger = logger or PhaseLogger(verbose=False)
     history: list[EpochResult] = []
 
     from distributed_deep_learning_tpu.utils.failures import (
-        maybe_inject_failure)
+        maybe_inject_failure, maybe_inject_step_failure)
+
+    spe = len(train_loader)  # steps per epoch
+
+    # resume sanity (review findings): a decoded start_epoch far past the
+    # run's epochs means the directory's ids were written under different
+    # settings (a gstep id read as a legacy epoch id); and existing ids
+    # must be able to ADVANCE, or every save of this run would be shadowed
+    # by a stale higher id and each restart would repeat the same work.
+    if start_epoch > epochs + 1:
+        raise ValueError(
+            f"resume point epoch {start_epoch} is past epochs={epochs}: the "
+            "checkpoint directory was written under different settings "
+            "(--checkpoint-every cadence or batch size) — use a fresh "
+            "--checkpoint-dir or the original flags")
+    if checkpointer is not None and start_epoch <= epochs:
+        last = checkpointer.latest_step()
+        final_id = epochs * spe if checkpoint_every else epochs
+        if last is not None and last >= final_id:
+            raise ValueError(
+                f"existing checkpoint id {last} >= this run's final id "
+                f"{final_id}: saves could never advance past it (the "
+                "directory was written with a different --checkpoint-every "
+                "or batch size) — use a fresh --checkpoint-dir or the "
+                "original flags")
 
     for epoch in range(start_epoch, epochs + 1):  # reference counts from 1
         maybe_inject_failure(epoch)  # chaos drill (DDL_INJECT_FAILURE)
         train_loader.set_epoch(epoch)
+        skip = resume_batch if epoch == start_epoch else 0
+        init_totals = resume_totals if epoch == start_epoch else None
+
+        def on_step(b, st, totals_fn, _epoch=epoch):
+            gstep = (_epoch - 1) * spe + b
+            maybe_inject_step_failure(gstep)  # DDL_INJECT_STEP_FAILURE
+            if checkpointer is not None and checkpoint_every \
+                    and b % checkpoint_every == 0 and b < spe:
+                t = totals_fn()
+                checkpointer.save(
+                    gstep, st,
+                    extra={"epoch": _epoch, "batch": b,
+                           "epoch_complete": False,
+                           "totals": {k: float(v) for k, v in t.items()}})
+
         t0 = logger.phase_begin("train", epoch)
         state, totals = _run_phase(train_step, state, train_loader,
-                                   train=True, monitor=monitor)
+                                   train=True, monitor=monitor, skip=skip,
+                                   init_totals=init_totals, on_step=on_step)
         t1 = logger.clock()
         res = _result("train", epoch, totals, t0, t1)
         logger.phase_end("train", epoch, accuracy=res.accuracy, loss=res.loss)
@@ -110,7 +190,12 @@ def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
         history.append(res)
 
         if checkpointer is not None:
-            checkpointer.save(epoch, state)
+            # uniform global-step ids under step cadence; legacy epoch ids
+            # without (keeps old run dirs resumable)
+            step_id = epoch * spe if checkpoint_every else epoch
+            checkpointer.save(step_id, state,
+                              extra={"epoch": epoch, "batch": spe,
+                                     "epoch_complete": True})
 
     if checkpointer is not None:
         checkpointer.wait_until_finished()

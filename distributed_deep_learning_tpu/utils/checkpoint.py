@@ -52,14 +52,79 @@ class Checkpointer:
 
     # -- save ---------------------------------------------------------------
     def save(self, step: int, state: TrainState, *, force: bool = False,
-             wait: bool = False) -> bool:
+             wait: bool = False, extra: dict | None = None) -> bool:
         """Persist `state` under `step`.  Async by default (the save runs
-        while training continues); `wait` blocks until durable."""
+        while training continues); `wait` blocks until durable.
+
+        ``extra`` is an optional small JSON-serialisable dict saved as a
+        sidecar next to the orbax step (loader position, partial-phase
+        totals — the mid-epoch resume metadata).  Only the coordinator
+        writes it (process 0); every process reads it back identically
+        from the shared run directory.  The sidecar is written BEFORE the
+        orbax save so a finalised step always has its sidecar (a kill in
+        between leaves a harmless orphan, collected below); an already-
+        finalised ``step`` is skipped, not re-saved (the elastic retry
+        replaying a boundary it already persisted)."""
+        if step in set(self._mgr.all_steps()):
+            if wait:
+                self._mgr.wait_until_finished()
+            return False
+        if extra is not None and jax.process_index() == 0:
+            import json
+
+            path = self._extra_path(step)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(extra, f)
+            os.replace(tmp, path)  # atomic on POSIX
         saved = self._mgr.save(
             step, args=ocp.args.StandardSave(_as_pytree(state)), force=force)
+        if jax.process_index() == 0:
+            self._gc_sidecars()
         if wait:
             self._mgr.wait_until_finished()
         return saved
+
+    def _extra_path(self, step: int) -> str:
+        return os.path.join(self._dir, f"extra-{step}.json")
+
+    def _gc_sidecars(self) -> None:
+        """Drop sidecars whose checkpoint orbax has pruned (max_to_keep).
+
+        Only steps BELOW the newest finalised one are candidates: steps are
+        saved in increasing order, so anything above it is still in flight
+        and must keep its (pre-written) sidecar."""
+        import glob
+
+        finalised = set(self._mgr.all_steps())
+        if not finalised:
+            return
+        newest = max(finalised)
+        for path in glob.glob(os.path.join(self._dir, "extra-*.json")):
+            name = os.path.basename(path)
+            try:
+                step = int(name[len("extra-"):-len(".json")])
+            except ValueError:
+                continue
+            if step < newest and step not in finalised:
+                try:
+                    os.remove(path)
+                except OSError:  # pragma: no cover - concurrent cleanup
+                    pass
+
+    def read_extra(self, step: int | None = None) -> dict | None:
+        """The `extra` sidecar saved with `step` (default: latest), or None
+        (pre-sidecar checkpoints / never saved with extra)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        import json
+
+        try:
+            with open(self._extra_path(step)) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
 
     # -- restore ------------------------------------------------------------
     def latest_step(self) -> int | None:

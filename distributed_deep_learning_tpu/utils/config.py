@@ -140,6 +140,7 @@ class Config:
     dropout: float = 0.0                # train-time dropout rate (north-star models)
     remat: bool = False                 # rematerialise activations in backward
     checkpoint_dir: str | None = None
+    checkpoint_every: int = 0           # also save every N train steps (0 = epoch-only)
     resume: bool = False
     profile_dir: str | None = None
     data_dir: str | None = None         # real-data root (ImageFolder layout)
@@ -252,6 +253,11 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
                         "quantization with int32 reduction (EQuARX-style "
                         "numerics)")
     p.add_argument("--checkpoint-dir", type=str, default=None)
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   help="also checkpoint every N train steps (0 = per "
+                        "epoch only); a preemption then costs at most N "
+                        "steps — resume replays the loader to the exact "
+                        "batch")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--profile-dir", type=str, default=None)
     p.add_argument("--data-dir", type=str, default=None,
@@ -360,6 +366,13 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
                env: dict[str, str] | None = None) -> Config:
     args = build_parser(workload).parse_args(argv)
     dist = DistributedEnv.from_environ(env)
+    if args.checkpoint_every and not args.checkpoint_dir:
+        raise SystemExit("--checkpoint-every requires --checkpoint-dir "
+                         "(silently dropping the cadence would be worse "
+                         "than an error)")
+    if args.checkpoint_every < 0:
+        raise SystemExit(f"--checkpoint-every {args.checkpoint_every}: "
+                         "must be >= 0")
     return Config(
         num_layers=args.nlayers,
         size=args.size,
@@ -383,6 +396,7 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         dropout=args.dropout,
         remat=args.remat,
         checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
         resume=args.resume,
         profile_dir=args.profile_dir,
         data_dir=args.data_dir,

@@ -154,6 +154,38 @@ class FailureMonitor:
 
 
 _injected = False
+_step_injected = False
+
+
+def maybe_inject_step_failure(global_step: int) -> None:
+    """Step-granular chaos hook: ``DDL_INJECT_STEP_FAILURE="<rank>:<step>"``
+    raises ONE ``RuntimeError`` right after that global train step on that
+    rank (or ``all``) — the mid-epoch preemption drill for
+    ``--checkpoint-every`` (VERDICT r4 item 5: recovery must cost at most
+    N steps, not an epoch)."""
+    global _step_injected
+    spec = os.environ.get("DDL_INJECT_STEP_FAILURE")
+    if not spec or _step_injected:
+        return
+    parts = spec.split(":")
+    if len(parts) != 2 or (parts[0] != "all" and not parts[0].isdigit()) \
+            or not parts[1].isdigit():
+        raise ValueError(
+            f"DDL_INJECT_STEP_FAILURE={spec!r}: expected '<rank>:<step>' "
+            "with rank a process index or 'all', e.g. '1:5' or 'all:5'")
+    rank_s, step_s = parts
+    import jax
+
+    hit = rank_s == "all" or jax.process_index() == int(rank_s)
+    if hit and global_step == int(step_s):
+        _step_injected = True
+        import sys
+
+        print(f"CHAOS: injected failure on rank {jax.process_index()} "
+              f"at step {step_s}", file=sys.stderr, flush=True)
+        raise RuntimeError(
+            f"injected failure (DDL_INJECT_STEP_FAILURE={spec}) on rank "
+            f"{jax.process_index()} at step {step_s}")
 
 
 def maybe_inject_failure(epoch: int) -> None:

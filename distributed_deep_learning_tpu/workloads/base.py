@@ -311,14 +311,21 @@ class StagedTrainer:
 
 
 def _maybe_checkpointer(config: Config):
-    """(checkpointer, start_epoch) from config; (None, 1) when disabled."""
+    """(checkpointer, resume point) from config.
+
+    Returns ``(ckpt, ckpt_step, start_epoch, resume_batch, resume_totals)``
+    — ``resume_batch > 0`` means mid-epoch resume at that batch of
+    ``start_epoch`` (``--checkpoint-every`` step saves record the loader
+    position in the sidecar)."""
     if not config.checkpoint_dir:
-        return None, 1
+        return None, None, 1, 0, None
+    from distributed_deep_learning_tpu.train.elastic import resume_point
     from distributed_deep_learning_tpu.utils.checkpoint import Checkpointer
 
     ckpt = Checkpointer(config.checkpoint_dir)
-    last = ckpt.latest_step() if config.resume else None
-    return ckpt, (last + 1 if last is not None else 1)
+    if not config.resume:
+        return ckpt, None, 1, 0, None
+    return (ckpt, *resume_point(ckpt))
 
 
 def _fit_elastic(config: Config, logger, make_state, train_step, eval_step,
@@ -346,7 +353,8 @@ def _fit_elastic(config: Config, logger, make_state, train_step, eval_step,
             return fit_with_recovery(make_state, train_step, eval_step,
                                      loaders, epochs=config.epochs,
                                      checkpointer=ckpt, logger=logger,
-                                     monitor=monitor)
+                                     monitor=monitor,
+                                     checkpoint_every=config.checkpoint_every)
     finally:
         if monitor is not None:
             monitor.stop()
@@ -489,7 +497,8 @@ def _run_spmd_pipelined(spec: WorkloadSpec, config: Config, devices, logger,
             interleaved=config.pipeline_schedule == "interleaved")
     loaders = make_loaders(dataset, splits, config.batch_size, mesh,
                            seed=config.seed)
-    ckpt, start_epoch = _maybe_checkpointer(config)
+    ckpt, ckpt_step, start_epoch, resume_batch, resume_totals = \
+        _maybe_checkpointer(config)
     if config.elastic:
         def make_state():
             s = TrainState.create(apply_fn=model.apply_fn,
@@ -499,14 +508,19 @@ def _run_spmd_pipelined(spec: WorkloadSpec, config: Config, devices, logger,
 
         return _fit_elastic(config, logger, make_state, train_step,
                             eval_step, loaders, ckpt)
-    if ckpt is not None and start_epoch > 1:
-        state = ckpt.restore(state) or state
-        logger.info(f"resumed from epoch {start_epoch - 1}")
+    if ckpt is not None and ckpt_step is not None:
+        state = ckpt.restore(state, step=ckpt_step) or state
+        logger.info(f"resumed mid-epoch {start_epoch} at step {resume_batch}"
+                    if resume_batch else
+                    f"resumed from epoch {start_epoch - 1}")
     try:
         with profiling.trace(config.profile_dir):
             return fit(state, train_step, eval_step, *loaders,
                        epochs=config.epochs, logger=logger,
-                       checkpointer=ckpt, start_epoch=start_epoch)
+                       checkpointer=ckpt, start_epoch=start_epoch,
+                       checkpoint_every=config.checkpoint_every,
+                       resume_batch=resume_batch,
+                       resume_totals=resume_totals)
     finally:
         if ckpt is not None:
             ckpt.close()
@@ -679,7 +693,8 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
             train_step, eval_step = make_step_fns(mesh, loss_fn,
                                                   state_spec=state_spec,
                                                   remat=config.remat)
-        ckpt, start_epoch = _maybe_checkpointer(config)
+        ckpt, ckpt_step, start_epoch, resume_batch, resume_totals = \
+            _maybe_checkpointer(config)
         if config.elastic:
             def make_state():
                 s = create_train_state(model, rng, example, tx,
@@ -688,14 +703,20 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
 
             return _fit_elastic(config, logger, make_state, train_step,
                                 eval_step, loaders, ckpt)
-        if ckpt is not None and start_epoch > 1:
-            state = ckpt.restore(state) or state
-            logger.info(f"resumed from epoch {start_epoch - 1}")
+        if ckpt is not None and ckpt_step is not None:
+            state = ckpt.restore(state, step=ckpt_step) or state
+            logger.info(
+                f"resumed mid-epoch {start_epoch} at step {resume_batch}"
+                if resume_batch else
+                f"resumed from epoch {start_epoch - 1}")
         try:
             with profiling.trace(config.profile_dir):
                 return fit(state, train_step, eval_step, *loaders,
                            epochs=config.epochs, logger=logger,
-                           checkpointer=ckpt, start_epoch=start_epoch)
+                           checkpointer=ckpt, start_epoch=start_epoch,
+                           checkpoint_every=config.checkpoint_every,
+                           resume_batch=resume_batch,
+                           resume_totals=resume_totals)
         finally:
             if ckpt is not None:
                 ckpt.close()

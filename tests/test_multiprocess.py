@@ -115,6 +115,38 @@ def test_two_process_checkpoint_restart(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_step_granular_mid_epoch_recovery(tmp_path):
+    """VERDICT r4 item 5: both ranks die MID-EPOCH (step 8 = epoch 2,
+    batch 3 of 5) under --checkpoint-every 2; recovery resumes from the
+    step-7 boundary — not the epoch — and the finished run's final test
+    metrics EQUAL an uninterrupted run's (bit-identical continuation)."""
+    base = ["mlp", "-e", "2", "-b", "64", "-m", "data", "-r", "2",
+            "--checkpoint-every", "2"]
+    env = {"DDL_DATA_LIMIT": "512"}
+
+    ref = launch_local(2, [*base, "--checkpoint-dir",
+                           str(tmp_path / "ref")], extra_env=env,
+                       timeout=420)
+    assert all(r.returncode == 0 for r in ref)
+
+    res = launch_local(2, [*base, "--elastic", "--checkpoint-dir",
+                           str(tmp_path / "ck")],
+                       extra_env={**env, "DDL_INJECT_STEP_FAILURE": "all:8"},
+                       timeout=420)
+    assert all(r.returncode == 0 for r in res)
+    for rank, r in enumerate(res):
+        assert f"CHAOS: injected failure on rank {rank} at step 8" in r.stdout
+    out = res[0].stdout
+    # recovery happened at STEP granularity (epoch 2, step 2 saved)
+    assert "restart 1/2 from epoch 2 step 2" in out
+    # and the result is the uninterrupted run's, to the last digit
+    final = re.search(r'"test ends at .* with (accuracy .*)"', out)
+    ref_final = re.search(r'"test ends at .* with (accuracy .*)"',
+                          ref[0].stdout)
+    assert final and ref_final and final.group(1) == ref_final.group(1)
+
+
+@pytest.mark.slow
 def test_two_process_elastic_recovery_preemption():
     """VERDICT r4 item 6: the whole 2-process job FAILS at epoch 2 (the
     pod-preemption drill — on a real pod the scheduler kills and restarts
