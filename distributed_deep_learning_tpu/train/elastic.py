@@ -98,6 +98,10 @@ def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
             restarts += 1
             if restarts > max_restarts:
                 raise
+            # flush BEFORE reading the point for the log too, or a save
+            # still in flight makes the message claim an older boundary
+            # than the retry will actually use (review finding)
+            checkpointer.wait_until_finished()
             _, ep, b, _ = resume_point(checkpointer)
             at = f"epoch {ep} step {b}" if b else f"epoch {ep}"
             logger.info(f"recovering from failure ({type(e).__name__}: {e}); "

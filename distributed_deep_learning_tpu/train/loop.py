@@ -127,17 +127,17 @@ def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
 
     spe = len(train_loader)  # steps per epoch
 
-    # resume sanity (review findings): a decoded start_epoch far past the
-    # run's epochs means the directory's ids were written under different
-    # settings (a gstep id read as a legacy epoch id); and existing ids
-    # must be able to ADVANCE, or every save of this run would be shadowed
-    # by a stale higher id and each restart would repeat the same work.
+    # resume sanity (review findings): a resume point at/past this run's
+    # epochs trains nothing further — say so instead of silently running
+    # only the final test; and existing ids must be able to ADVANCE, or
+    # every save of this run would be shadowed by a stale higher id and
+    # each restart would repeat the same work.
     if start_epoch > epochs + 1:
-        raise ValueError(
-            f"resume point epoch {start_epoch} is past epochs={epochs}: the "
-            "checkpoint directory was written under different settings "
-            "(--checkpoint-every cadence or batch size) — use a fresh "
-            "--checkpoint-dir or the original flags")
+        logger.info(
+            f"checkpoint resume point (epoch {start_epoch - 1}) is past "
+            f"epochs={epochs}; nothing left to train — running the final "
+            "test only (rerun with more -e epochs to continue)")
+        start_epoch = epochs + 1
     if checkpointer is not None and start_epoch <= epochs:
         last = checkpointer.latest_step()
         final_id = epochs * spe if checkpoint_every else epochs

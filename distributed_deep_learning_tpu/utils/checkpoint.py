@@ -63,12 +63,17 @@ class Checkpointer:
         from the shared run directory.  The sidecar is written BEFORE the
         orbax save so a finalised step always has its sidecar (a kill in
         between leaves a harmless orphan, collected below); an already-
-        finalised ``step`` is skipped, not re-saved (the elastic retry
-        replaying a boundary it already persisted)."""
+        finalised ``step`` is skipped, not re-saved — ONLY safe because a
+        run never reuses a dirty directory without ``--resume``
+        (:func:`..workloads.base._maybe_checkpointer` rejects that), so a
+        replayed id within a run carries bit-identical state (the elastic
+        retry).  ``force=True`` really overwrites (delete + save)."""
         if step in set(self._mgr.all_steps()):
-            if wait:
-                self._mgr.wait_until_finished()
-            return False
+            if not force:
+                if wait:
+                    self._mgr.wait_until_finished()
+                return False
+            self._mgr.delete(step)
         if extra is not None and jax.process_index() == 0:
             import json
 

@@ -148,20 +148,54 @@ def test_id_scheme_mismatch_rejected(tmp_path, mesh8):
         with pytest.raises(ValueError, match="never advance"):
             fit(make_state(), train_step, eval_step, *loaders, epochs=3,
                 checkpointer=ckpt, start_epoch=3)
-        # absurd decoded epoch (gstep id misread as a legacy epoch id)
-        with pytest.raises(ValueError, match="past epochs"):
-            fit(make_state(), train_step, eval_step, *loaders, epochs=2,
-                checkpointer=ckpt, start_epoch=SPE * 2 + 1)
+        # a resume point past this run's epochs trains nothing further but
+        # completes gracefully (dir trained longer than the rerun asks)
+        state, hist = fit(make_state(), train_step, eval_step, *loaders,
+                          epochs=2, checkpointer=ckpt,
+                          start_epoch=SPE * 2 + 1)
+        assert [h.phase for h in hist] == ["test"]
 
 
 def test_save_skips_already_finalised_step(tmp_path, mesh8):
     """An elastic retry replaying a boundary it already persisted is a
-    no-op, not an orbax StepAlreadyExistsError."""
+    no-op, not an orbax StepAlreadyExistsError — and force=True really
+    overwrites."""
+    import jax.numpy as jnp
+
     make_state, _, _ = _setup(mesh8)
     with Checkpointer(tmp_path / "ck") as ckpt:
-        assert ckpt.save(3, make_state(), wait=True, extra={"epoch": 1})
-        assert ckpt.save(3, make_state(), wait=True, extra={"epoch": 1}) \
-            is False
+        s0 = make_state()
+        assert ckpt.save(3, s0, wait=True, extra={"epoch": 1})
+        assert ckpt.save(3, s0, wait=True, extra={"epoch": 1}) is False
+        bumped = s0.replace(params=jax.tree.map(lambda a: a + 1.0, s0.params))
+        assert ckpt.save(3, bumped, wait=True, force=True)
+        back = ckpt.restore(make_state(), step=3)
+        leaf = jax.tree_util.tree_leaves(back.params)[0]
+        ref = jax.tree_util.tree_leaves(bumped.params)[0]
+        np.testing.assert_array_equal(np.asarray(leaf), np.asarray(ref))
+
+
+def test_dirty_dir_without_resume_rejected(tmp_path, mesh8):
+    """A fresh (non-resume, non-elastic) run over a dir holding another
+    run's checkpoints must refuse, not silently skip its own saves in
+    favour of the old steps (review finding)."""
+    from distributed_deep_learning_tpu.utils.config import Config
+    from distributed_deep_learning_tpu.workloads.base import (
+        _maybe_checkpointer)
+
+    make_state, _, _ = _setup(mesh8)
+    d = str(tmp_path / "ck")
+    with Checkpointer(d) as ckpt:
+        ckpt.save(1, make_state(), wait=True)
+    with pytest.raises(ValueError, match="already holds"):
+        _maybe_checkpointer(Config(checkpoint_dir=d))
+    # --resume and --elastic both legitimately reuse the dir
+    ck2, step, *_ = _maybe_checkpointer(Config(checkpoint_dir=d,
+                                               resume=True))
+    ck2.close()
+    assert step == 1
+    ck3, *_ = _maybe_checkpointer(Config(checkpoint_dir=d, elastic=True))
+    ck3.close()
 
 
 def test_sidecar_gc_follows_orbax_pruning(tmp_path, mesh8):
