@@ -14,11 +14,10 @@ sharded :class:`..loader.DeviceLoader`.
 from __future__ import annotations
 
 import os
-import threading
-from collections import OrderedDict
-from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
+
+from distributed_deep_learning_tpu.data._threaded import ThreadedDecodeMixin
 
 IMAGE_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp")
 
@@ -32,7 +31,7 @@ def find_classes(root: str) -> tuple[list[str], dict[str, int]]:
     return classes, {c: i for i, c in enumerate(classes)}
 
 
-class ImageFolderDataset:
+class ImageFolderDataset(ThreadedDecodeMixin):
     """``root/<class>/*.jpg`` → (image, one-hot) batches."""
 
     def __init__(self, root: str, image_size: int = 224, *,
@@ -50,50 +49,27 @@ class ImageFolderDataset:
                                              self.class_to_idx[cls]))
         if not self.samples:
             raise FileNotFoundError(f"no images under {root}")
-        self._pool = ThreadPoolExecutor(max(1, num_workers)) \
-            if num_workers > 1 else None
-        self._cache: OrderedDict[str, np.ndarray] = OrderedDict()
-        self._cache_lock = threading.Lock()  # decode threads share the LRU
-        self._max_cached = max_cached_images
+        self._init_decode(num_workers, max_cached_images)
 
     def __len__(self) -> int:
         return len(self.samples)
 
-    def _decode(self, path: str) -> np.ndarray:
-        with self._cache_lock:
-            img = self._cache.get(path)
-            if img is not None:
-                self._cache.move_to_end(path)
-                return img
+    def _decode_resized(self, path: str) -> np.ndarray:
         from PIL import Image
 
         from distributed_deep_learning_tpu import native
 
-        # decode outside the lock (PIL releases the GIL; a rare duplicate
-        # decode of the same path is cheaper than serialising the pool)
         with Image.open(path) as im:
             raw = np.asarray(im.convert("RGB"), dtype=np.float32)
         h, w = raw.shape[:2]
-        img = native.crop_resize_bilinear(np.ascontiguousarray(raw), 0, 0,
-                                          h, w, self.image_size,
-                                          self.image_size)
-        with self._cache_lock:
-            self._cache[path] = img
-            while len(self._cache) > self._max_cached:
-                self._cache.popitem(last=False)
-        return img
+        return native.crop_resize_bilinear(np.ascontiguousarray(raw), 0, 0,
+                                           h, w, self.image_size,
+                                           self.image_size)
 
     def item(self, index: int) -> tuple[np.ndarray, np.ndarray]:
         path, target = self.samples[index]
         y = np.zeros(len(self.classes), dtype=np.float32)
         y[target] = 1.0
-        return self._decode(path), y
+        return self._cached(path, self._decode_resized), y
 
-    def batch(self, indices: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-        idx = [int(i) for i in np.asarray(indices)]
-        if self._pool is not None:
-            items = list(self._pool.map(self.item, idx))
-        else:
-            items = [self.item(i) for i in idx]
-        return (np.stack([x for x, _ in items]),
-                np.stack([y for _, y in items]))
+    # batch() comes from ThreadedDecodeMixin (threaded item decode)

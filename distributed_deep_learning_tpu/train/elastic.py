@@ -81,6 +81,13 @@ def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
             resume_point(checkpointer)
         if ckpt_step is not None:
             state = checkpointer.restore(state, step=ckpt_step) or state
+            # loud on purpose: an elastic (re)launch over an existing dir
+            # silently continuing the OLD run would be the dirty-dir
+            # hazard _maybe_checkpointer refuses for non-elastic runs
+            at = f"epoch {start_epoch} step {resume_batch}" \
+                if resume_batch else f"epoch {start_epoch}"
+            logger.info(f"elastic: restored checkpoint step {ckpt_step}; "
+                        f"continuing from {at}")
         try:
             if monitor is not None:
                 monitor.raise_if_failed()

@@ -64,16 +64,23 @@ class Checkpointer:
         orbax save so a finalised step always has its sidecar (a kill in
         between leaves a harmless orphan, collected below); an already-
         finalised ``step`` is skipped, not re-saved — ONLY safe because a
-        run never reuses a dirty directory without ``--resume``
-        (:func:`..workloads.base._maybe_checkpointer` rejects that), so a
-        replayed id within a run carries bit-identical state (the elastic
-        retry).  ``force=True`` really overwrites (delete + save)."""
+        run never reuses a dirty directory without ``--resume`` or
+        ``--elastic`` (:func:`..workloads.base._maybe_checkpointer`
+        rejects that, and elastic restores-then-continues, logging what it
+        restored), so a replayed id within a run carries bit-identical
+        state (the elastic retry).  ``force=True`` really overwrites
+        (delete + save, sidecar included)."""
         if step in set(self._mgr.all_steps()):
             if not force:
                 if wait:
                     self._mgr.wait_until_finished()
                 return False
             self._mgr.delete(step)
+            if jax.process_index() == 0:
+                try:  # the old step's sidecar must not outlive it
+                    os.remove(self._extra_path(step))
+                except FileNotFoundError:
+                    pass
         if extra is not None and jax.process_index() == 0:
             import json
 
@@ -85,7 +92,7 @@ class Checkpointer:
         saved = self._mgr.save(
             step, args=ocp.args.StandardSave(_as_pytree(state)), force=force)
         if jax.process_index() == 0:
-            self._gc_sidecars()
+            self._gc_sidecars(protect=step)
         if wait:
             self._mgr.wait_until_finished()
         return saved
@@ -93,12 +100,15 @@ class Checkpointer:
     def _extra_path(self, step: int) -> str:
         return os.path.join(self._dir, f"extra-{step}.json")
 
-    def _gc_sidecars(self) -> None:
+    def _gc_sidecars(self, protect: int | None = None) -> None:
         """Drop sidecars whose checkpoint orbax has pruned (max_to_keep).
 
         Only steps BELOW the newest finalised one are candidates: steps are
         saved in increasing order, so anything above it is still in flight
-        and must keep its (pre-written) sidecar."""
+        and must keep its (pre-written) sidecar.  ``protect`` exempts the
+        step whose save is in flight RIGHT NOW — a ``force=True``
+        re-save of a non-latest step sits below the newest finalised id
+        and would otherwise lose its fresh sidecar (review finding)."""
         import glob
 
         finalised = set(self._mgr.all_steps())
@@ -111,7 +121,7 @@ class Checkpointer:
                 step = int(name[len("extra-"):-len(".json")])
             except ValueError:
                 continue
-            if step < newest and step not in finalised:
+            if step < newest and step not in finalised and step != protect:
                 try:
                     os.remove(path)
                 except OSError:  # pragma: no cover - concurrent cleanup

@@ -324,15 +324,18 @@ def _maybe_checkpointer(config: Config):
 
     ckpt = Checkpointer(config.checkpoint_dir)
     if not config.resume:
-        if not config.elastic and ckpt.latest_step() is not None:
+        last = ckpt.latest_step()
+        if not config.elastic and last is not None:
             # a dirty dir without --resume would let this run's saves be
             # silently skipped in favour of the OLD run's steps (save()
             # skips already-finalised ids) — refuse up front.  --elastic
-            # is exempt: its whole contract is resume-on-restart.
+            # is exempt: its whole contract is resume-on-restart (and it
+            # logs what it restored).
+            ckpt.close()
             raise ValueError(
                 f"--checkpoint-dir {config.checkpoint_dir} already holds "
-                f"checkpoints (latest step {ckpt.latest_step()}): pass "
-                "--resume to continue it, or point at a fresh directory")
+                f"checkpoints (latest step {last}): pass --resume to "
+                "continue it, or point at a fresh directory")
         return ckpt, None, 1, 0, None
     return (ckpt, *resume_point(ckpt))
 

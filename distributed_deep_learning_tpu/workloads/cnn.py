@@ -23,19 +23,31 @@ from distributed_deep_learning_tpu.workloads.base import (
 NUM_CLASSES = 6  # PCB defect classes (reference CNN/dataset.py class dirs)
 
 
+def _num_classes(dataset) -> int:
+    """Class count from the DATASET (a real --data-dir tree may not have
+    the reference's 6 classes; a hardcoded head width broadcasts-crashes
+    at the loss — caught by the round-5 verify drive)."""
+    classes = getattr(dataset, "classes", None)
+    if classes is not None:
+        return len(classes)
+    return int(dataset.targets.shape[-1])  # one-hot synthetic twin
+
+
 def _dataset(config: Config):
+    workers = config.num_workers or None  # -w: decode thread count
     if config.data_dir:
         # an explicit --data-dir must fail loudly, not silently fall back
-        return PCBDataset(root=config.data_dir, seed=config.seed)
+        return PCBDataset(root=config.data_dir, seed=config.seed,
+                          workers=workers)
     try:
-        return PCBDataset(seed=config.seed)
+        return PCBDataset(seed=config.seed, workers=workers)
     except FileNotFoundError:
         return synthetic_pcb(seed=config.seed, num_classes=NUM_CLASSES)
 
 
 def _model(config: Config, dataset):
     return DenseNet(dense_blocks=config.num_layers, bn_size=config.size,
-                    num_classes=NUM_CLASSES,
+                    num_classes=_num_classes(dataset),
                     double_softmax=config.double_softmax,
                     dtype=config_dtype(config))
 
@@ -43,7 +55,8 @@ def _model(config: Config, dataset):
 def _layers(config: Config, dataset):
     return densenet_layer_sequence(
         dense_blocks=config.num_layers, bn_size=config.size,
-        num_classes=NUM_CLASSES, double_softmax=config.double_softmax,
+        num_classes=_num_classes(dataset),
+        double_softmax=config.double_softmax,
         dtype=config_dtype(config))
 
 

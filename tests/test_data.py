@@ -78,3 +78,45 @@ def test_loader_rejects_indivisible_batch(mesh8):
 def test_array_dataset_validates():
     with pytest.raises(ValueError):
         ArrayDataset(np.zeros((4, 2)), np.zeros((5, 2)))
+
+
+@pytest.mark.slow
+def test_data_soak_script_micro(tmp_path):
+    """scripts/data_soak.py at micro scale: the reference-scale soak
+    harness (VERDICT r4 item 7) keeps running end to end."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "data_soak", os.path.join(os.path.dirname(__file__), "..",
+                                  "scripts", "data_soak.py"))
+    soak = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(soak)
+    # batches sized below each micro corpus so the loader loop actually
+    # runs (review finding: drop_remainder would otherwise yield nothing)
+    soak.soak_pdm(str(tmp_path), machines=2, ipm=100, batch=64)
+    soak.soak_mqtt(str(tmp_path), rows=500, batch=128)
+    soak.soak_pcb(str(tmp_path), classes=2, per_class=4, batch=8)
+
+
+def test_pcb_threaded_batch_matches_serial(tmp_path):
+    """The round-5 threaded PCB batch decode is bit-identical to serial
+    (same LRU dataset, workers=1 vs workers=4)."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "data_soak", os.path.join(os.path.dirname(__file__), "..",
+                                  "scripts", "data_soak.py"))
+    soak = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(soak)
+    from distributed_deep_learning_tpu.data.pcb import PCBDataset
+
+    soak.gen_pcb_tree(str(tmp_path / "pcb"), classes=2, per_class=3)
+    serial = PCBDataset(str(tmp_path / "pcb"), workers=1)
+    threaded = PCBDataset(str(tmp_path / "pcb"), workers=4)
+    idx = np.arange(len(serial))
+    xs, ys = serial.batch(idx)
+    xt, yt = threaded.batch(idx)
+    np.testing.assert_array_equal(xs, xt)
+    np.testing.assert_array_equal(ys, yt)

@@ -169,6 +169,9 @@ def test_save_skips_already_finalised_step(tmp_path, mesh8):
         assert ckpt.save(3, s0, wait=True, extra={"epoch": 1}) is False
         bumped = s0.replace(params=jax.tree.map(lambda a: a + 1.0, s0.params))
         assert ckpt.save(3, bumped, wait=True, force=True)
+        # force without extra removed the stale sidecar too (review
+        # finding: old resume metadata must not describe the new state)
+        assert ckpt.read_extra(3) is None
         back = ckpt.restore(make_state(), step=3)
         leaf = jax.tree_util.tree_leaves(back.params)[0]
         ref = jax.tree_util.tree_leaves(bumped.params)[0]
