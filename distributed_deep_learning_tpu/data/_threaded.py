@@ -49,9 +49,11 @@ class ThreadedDecodeMixin:
     def _map_items(self, idx: list[int]) -> list:
         if self._workers > 1 and len(idx) > 1:
             if self._pool is None:
-                from concurrent.futures import ThreadPoolExecutor
+                with self._cache_lock:  # two pump threads must not race
+                    if self._pool is None:
+                        from concurrent.futures import ThreadPoolExecutor
 
-                self._pool = ThreadPoolExecutor(self._workers)
+                        self._pool = ThreadPoolExecutor(self._workers)
             return list(self._pool.map(self.item, idx))
         return [self.item(i) for i in idx]
 

@@ -101,9 +101,11 @@ class PCBDataset(ThreadedDecodeMixin):
         # Bounded LRU over decoded full-res images (PCB photos are ~14 MB
         # decoded; an unbounded cache would hold the whole corpus) plus
         # threaded batch decode, shared with ImageFolderDataset
-        # (:class:`.._threaded.ThreadedDecodeMixin`).  Measured in
-        # scripts/data_soak.py at reference scale (2952 images, shuffled):
-        # serial decode was ~253 samples/s — a training stall.
+        # (:class:`.._threaded.ThreadedDecodeMixin`).  The epoch is
+        # JPEG-decode-bound (~125 decodes/s/core, scripts/data_soak.py at
+        # reference scale): threads saturate the host's cores — flat on
+        # the 2-core CI box (~250 samples/s either way, both cores busy),
+        # ~8x headroom on a many-core TPU-VM host.
         self._init_decode(min(8, os.cpu_count() or 1) if workers is None
                           else workers, max_cached_images)
 

@@ -131,19 +131,59 @@ def _record_flash_gate(result: dict) -> None:
     record_flash_speedup(result["speedup"])
 
 
-def main():
+SECTIONS = ("flash_vs_dense", "s2d_vs_plain", "batch_sweep", "lm_tokens")
+
+
+def _run_section(name: str) -> None:
     import jax
 
-    on_tpu = jax.default_backend() == "tpu"
-    for fn in (flash_vs_dense, s2d_vs_plain, batch_sweep, lm_tokens):
+    if os.environ.get("TPU_VALIDATION_CPU") == "1":
+        # CPU smoke: the env var alone is not enough when a site plugin
+        # pins the platform — force via jax.config pre-backend-init
+        jax.config.update("jax_platforms", "cpu")
+    fn = globals()[name]
+    try:
+        result = fn()
+        print(json.dumps(result), flush=True)
+        if name == "flash_vs_dense" and jax.default_backend() == "tpu":
+            _record_flash_gate(result)
+    except Exception as exc:  # partial windows yield partial numbers
+        print(json.dumps({"section": name,
+                          "error": f"{type(exc).__name__}: {exc}"}),
+              flush=True)
+
+
+def main():
+    """Each section runs in ITS OWN watchdogged subprocess (round 5): the
+    axon transport can hang mid-compile, and a hang in section 1 must not
+    eat the whole healthy window — later sections still get their shot.
+    ``--section NAME`` runs one section inline (the child mode).
+    ``TPU_VALIDATION_SECTION_TIMEOUT`` (default 420 s) bounds each."""
+    import subprocess
+
+    if len(sys.argv) > 2 and sys.argv[1] == "--section":
+        _run_section(sys.argv[2])
+        return
+    budget = float(os.environ.get("TPU_VALIDATION_SECTION_TIMEOUT", 420))
+    for name in SECTIONS:
         try:
-            result = fn()
-            print(json.dumps(result))
-            if fn is flash_vs_dense and on_tpu:
-                _record_flash_gate(result)
-        except Exception as exc:  # partial windows yield partial numbers
-            print(json.dumps({"section": fn.__name__,
-                              "error": f"{type(exc).__name__}: {exc}"}))
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--section", name],
+                timeout=budget, stdout=subprocess.PIPE, text=True)
+            sys.stdout.write(proc.stdout)
+            sys.stdout.flush()
+            if proc.returncode != 0 and not proc.stdout.strip():
+                # crashed (OOM-kill, segfault in the TPU runtime, import
+                # error) rather than hung: record it like the old inline
+                # loop did instead of silently dropping the section
+                print(json.dumps({"section": name,
+                                  "error": f"child rc={proc.returncode}"}),
+                      flush=True)
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"section": name,
+                              "error": f"timeout after {budget:.0f}s"}),
+                  flush=True)
 
 
 if __name__ == "__main__":
