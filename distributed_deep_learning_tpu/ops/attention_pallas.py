@@ -390,7 +390,7 @@ def _warn_dense_mask_fallback() -> None:
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                     causal: bool = False, key_valid: jnp.ndarray | None = None,
                     sm_scale: float | None = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int | None = None, block_k: int | None = None,
                     window: int | None = None,
                     interpret: bool | None = None) -> jnp.ndarray:
     """Fused attention on ``(B, T, H, D)`` q/k/v (same layout as
@@ -408,6 +408,18 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if block_q is None or block_k is None:
+        # data-driven default: the best (block_q, block_k) the validation
+        # sweep measured on THIS repo's hardware history; 128x128 until a
+        # sweep has run (blocks larger than T are clamped by _fit_block)
+        rec = None
+        if jax.default_backend() == "tpu":
+            from distributed_deep_learning_tpu.utils.bench_records import (
+                read_flash_blocks)
+
+            rec = read_flash_blocks()
+        block_q = block_q or (rec[0] if rec else 128)
+        block_k = block_k or (rec[1] if rec else 128)
     if window is not None:
         if not causal:
             raise ValueError("window (sliding-window attention) requires "

@@ -18,6 +18,10 @@ import os
 #: (B=4, T=2048, H=8, D=64, bf16); > 1 means flash is faster
 FLASH_GATE_KEY = "tpu:flash_speedup_T2048_D64"
 
+#: best measured (block_q, block_k) from the validation block sweep —
+#: the production default the flash adapter resolves on TPU
+FLASH_BLOCKS_KEY = "tpu:flash_best_blocks"
+
 
 def baseline_path() -> str:
     """Absolute path of ``bench_baseline.json`` at the repo root."""
@@ -46,8 +50,29 @@ def read_flash_speedup() -> float | None:
 def record_flash_speedup(value: float) -> None:
     """Persist the latest measured ratio (latest wins — it is a decision
     datum for the ``--attention auto`` gate, not a first-run baseline)."""
+    _update({FLASH_GATE_KEY: round(float(value), 4)})
+
+
+def read_flash_blocks() -> tuple[int, int] | None:
+    """Best measured (block_q, block_k) for the flash kernel on this
+    repo's own hardware history; None when never swept."""
+    v = read_records().get(FLASH_BLOCKS_KEY)
+    if not isinstance(v, (list, tuple)) or len(v) < 2:
+        return None  # hand-edited/corrupt values must not crash (or
+    try:             # silently mis-block) every TPU training run
+        bq, bk = int(v[0]), int(v[1])
+        return (bq, bk) if bq > 0 and bk > 0 else None
+    except (TypeError, ValueError):
+        return None
+
+
+def record_flash_blocks(block_q: int, block_k: int) -> None:
+    _update({FLASH_BLOCKS_KEY: [int(block_q), int(block_k)]})
+
+
+def _update(kv: dict) -> None:
     records = read_records()
-    records[FLASH_GATE_KEY] = round(float(value), 4)
+    records.update(kv)
     try:
         with open(baseline_path(), "w") as f:
             json.dump(records, f, indent=1)

@@ -123,6 +123,53 @@ def lm_tokens(steps=10):
             "mfu": round(mfu, 4) if mfu else None}
 
 
+def flash_block_sweep(B=4, T=2048, H=8, D=64, steps=10):
+    """Tune the flash kernel's (block_q, block_k) on this hardware — the
+    first lever if the kernel lands below dense parity.  Records the best
+    config so :func:`..ops.attention_pallas.flash_attention` picks it up
+    as its TPU default (``tpu:flash_best_blocks``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_deep_learning_tpu.ops.attention_pallas import (
+        flash_attention)
+
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.bfloat16)
+               for kk in ks)
+    rows = []
+    best = None
+    for bq, bk in ((128, 128), (128, 256), (256, 128), (256, 256),
+                   (512, 128), (128, 512), (512, 512)):
+        try:
+            loss = jax.jit(jax.grad(lambda q: jnp.sum(flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bk) ** 2)))
+            _sync(loss(q))
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                g = loss(q)
+            _sync(g)
+            ms = (time.perf_counter() - t0) / steps * 1e3
+        except Exception as exc:  # a VMEM-overflowing config is a data
+            rows.append({"bq": bq, "bk": bk,      # point, not an abort
+                         "error": f"{type(exc).__name__}"})
+            continue
+        rows.append({"bq": bq, "bk": bk, "ms": round(ms, 3)})
+        if best is None or ms < best[2]:
+            best = (bq, bk, ms)
+    if best is None:
+        return {"section": "flash_block_sweep", "T": T, "rows": rows,
+                "best": None}
+    if jax.default_backend() == "tpu":
+        from distributed_deep_learning_tpu.utils.bench_records import (
+            record_flash_blocks)
+
+        record_flash_blocks(best[0], best[1])
+    return {"section": "flash_block_sweep", "T": T, "rows": rows,
+            "best": {"bq": best[0], "bk": best[1],
+                     "ms": round(best[2], 3)}}
+
+
 def _record_flash_gate(result: dict) -> None:
     """Persist the measured ratio as the `--attention auto` gate datum."""
     from distributed_deep_learning_tpu.utils.bench_records import (
@@ -131,7 +178,8 @@ def _record_flash_gate(result: dict) -> None:
     record_flash_speedup(result["speedup"])
 
 
-SECTIONS = ("flash_vs_dense", "s2d_vs_plain", "batch_sweep", "lm_tokens")
+SECTIONS = ("flash_block_sweep", "flash_vs_dense", "s2d_vs_plain",
+            "batch_sweep", "lm_tokens")
 
 
 def _run_section(name: str) -> None:
@@ -180,7 +228,11 @@ def main():
                 print(json.dumps({"section": name,
                                   "error": f"child rc={proc.returncode}"}),
                       flush=True)
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as exc:
+            if exc.stdout:  # results printed before the hang still count
+                out = exc.stdout if isinstance(exc.stdout, str) \
+                    else exc.stdout.decode(errors="replace")
+                sys.stdout.write(out)
             print(json.dumps({"section": name,
                               "error": f"timeout after {budget:.0f}s"}),
                   flush=True)

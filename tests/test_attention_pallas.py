@@ -216,6 +216,46 @@ def test_adapter_dense_mask_falls_back_to_dense_path():
         fn_w(q, k, v, mask=mask)
 
 
+def test_flash_blocks_records_roundtrip(tmp_path, monkeypatch):
+    """record/read of the tuned (block_q, block_k) datum, isolated from
+    the repo's real bench_baseline.json."""
+    from distributed_deep_learning_tpu.utils import bench_records as br
+
+    monkeypatch.setattr(br, "baseline_path",
+                        lambda: str(tmp_path / "b.json"))
+    assert br.read_flash_blocks() is None
+    br.record_flash_blocks(256, 512)
+    assert br.read_flash_blocks() == (256, 512)
+    br.record_flash_speedup(1.3)  # other keys coexist
+    assert br.read_flash_blocks() == (256, 512)
+    assert br.read_flash_speedup() == 1.3
+    # corrupt values degrade to None, never crash or mis-block
+    import json
+
+    for bad in ({"bq": 1}, "512", [128], [0, 128], None):
+        (tmp_path / "b.json").write_text(
+            json.dumps({br.FLASH_BLOCKS_KEY: bad}))
+        assert br.read_flash_blocks() is None, bad
+
+
+def test_flash_default_blocks_resolve_from_records(monkeypatch):
+    """On TPU the kernel's default blocks come from the recorded sweep;
+    _fit_block clamps oversized records to the sequence length, so the
+    call still works (and matches) at small T."""
+    from distributed_deep_learning_tpu.ops import attention_pallas as ap
+
+    q, k, v = _qkv(T=32, seed=50)
+    expected = flash_attention(q, k, v, causal=True, block_q=8, block_k=8)
+
+    monkeypatch.setattr("jax.default_backend", lambda: "tpu")
+    monkeypatch.setattr(
+        "distributed_deep_learning_tpu.utils.bench_records"
+        ".read_flash_blocks", lambda: (256, 512))
+    got = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_northstar_attention_flag_resolution():
     from distributed_deep_learning_tpu.utils.config import Config
     from distributed_deep_learning_tpu.workloads.northstar import (
