@@ -378,6 +378,24 @@ _flash_bhtd.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 @functools.cache
+def _recorded_blocks() -> tuple[int, int] | None:
+    """Data-driven default (block_q, block_k): the best config the
+    validation sweep measured on THIS repo's hardware history; None (→
+    128×128) until a sweep has run.  Cached per process — the datum is
+    static for a training run's lifetime, and re-reading the JSON per
+    trace would both cost on the hot path and let a mid-run rewrite
+    compile different traces with different blocks."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return None
+    from distributed_deep_learning_tpu.utils.bench_records import (
+        read_flash_blocks)
+
+    return read_flash_blocks()
+
+
+@functools.cache
 def _warn_dense_mask_fallback() -> None:
     import warnings
 
@@ -409,15 +427,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if block_q is None or block_k is None:
-        # data-driven default: the best (block_q, block_k) the validation
-        # sweep measured on THIS repo's hardware history; 128x128 until a
-        # sweep has run (blocks larger than T are clamped by _fit_block)
-        rec = None
-        if jax.default_backend() == "tpu":
-            from distributed_deep_learning_tpu.utils.bench_records import (
-                read_flash_blocks)
-
-            rec = read_flash_blocks()
+        rec = _recorded_blocks()
         block_q = block_q or (rec[0] if rec else 128)
         block_k = block_k or (rec[1] if rec else 128)
     if window is not None:

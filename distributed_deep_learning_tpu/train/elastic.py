@@ -49,6 +49,21 @@ def resume_point(checkpointer: Checkpointer
         extra.get("totals")
 
 
+def _merge_history(sink: list[EpochResult]) -> list[EpochResult]:
+    """Cross-attempt history: keep the LAST record per (phase, epoch) — a
+    phase re-run after a mid-validation failure supersedes its first
+    (identical, deterministic) record."""
+    seen: set = set()
+    merged: list[EpochResult] = []
+    for h in reversed(sink):
+        key = (h.phase, h.epoch)
+        if key in seen:
+            continue
+        seen.add(key)
+        merged.append(h)
+    return list(reversed(merged))
+
+
 def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
                       loaders: Sequence, epochs: int,
                       checkpointer: Checkpointer, *,
@@ -70,6 +85,8 @@ def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
     logger = logger or PhaseLogger(verbose=False)
     train_loader, val_loader, test_loader = loaders
     restarts = 0
+    sink: list[EpochResult] = []  # survives attempts (round-5 fix: the
+    # returned history used to hold only the FINAL attempt's epochs)
     while True:
         state = make_state()
         # flush in-flight async saves BEFORE reading the resume point: a
@@ -95,12 +112,14 @@ def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
             # fit polls the monitor before EVERY step, so a peer dying
             # mid-epoch aborts this attempt promptly rather than hanging
             # the next collective
-            return fit(state, train_step, eval_step, train_loader,
-                       val_loader, test_loader, epochs=epochs, logger=logger,
-                       checkpointer=checkpointer, start_epoch=start_epoch,
-                       monitor=monitor, checkpoint_every=checkpoint_every,
-                       resume_batch=resume_batch,
-                       resume_totals=resume_totals)
+            state, _ = fit(state, train_step, eval_step, train_loader,
+                           val_loader, test_loader, epochs=epochs,
+                           logger=logger, checkpointer=checkpointer,
+                           start_epoch=start_epoch, monitor=monitor,
+                           checkpoint_every=checkpoint_every,
+                           resume_batch=resume_batch,
+                           resume_totals=resume_totals, history_sink=sink)
+            return state, _merge_history(sink)
         except (WorkerFailure, RuntimeError) as e:
             restarts += 1
             if restarts > max_restarts:

@@ -99,7 +99,8 @@ def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
         test_loader, epochs: int, logger: PhaseLogger | None = None,
         checkpointer=None, start_epoch: int = 1, monitor=None,
         checkpoint_every: int | None = None, resume_batch: int = 0,
-        resume_totals: dict | None = None
+        resume_totals: dict | None = None,
+        history_sink: list | None = None
         ) -> tuple[TrainState, list[EpochResult]]:
     """Drive the epoch loop.  With a ``checkpointer``
     (:class:`..utils.checkpoint.Checkpointer`) the state is saved after
@@ -118,9 +119,15 @@ def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
     (from :meth:`Checkpointer.read_extra`) resume mid-epoch: the seeded
     loader replays ``start_epoch``'s batch order and the first
     ``resume_batch`` batches are skipped — continuation is bit-identical
-    to the uninterrupted run."""
+    to the uninterrupted run.
+
+    ``history_sink`` (a list) receives every EpochResult AS PRODUCED, so a
+    caller that catches a mid-run failure still holds the completed
+    phases' records — :func:`..elastic.fit_with_recovery` passes one sink
+    across attempts and the merged run history survives restarts."""
     logger = logger or PhaseLogger(verbose=False)
-    history: list[EpochResult] = []
+    history: list[EpochResult] = \
+        [] if history_sink is None else history_sink
 
     from distributed_deep_learning_tpu.utils.failures import (
         maybe_inject_failure, maybe_inject_step_failure)

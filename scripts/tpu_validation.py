@@ -232,6 +232,9 @@ def main():
             if exc.stdout:  # results printed before the hang still count
                 out = exc.stdout if isinstance(exc.stdout, str) \
                     else exc.stdout.decode(errors="replace")
+                # keep whole lines only: a child killed mid-write must not
+                # corrupt the one-JSON-object-per-line contract
+                out = out[:out.rfind("\n") + 1]
                 sys.stdout.write(out)
             print(json.dumps({"section": name,
                               "error": f"timeout after {budget:.0f}s"}),

@@ -218,6 +218,33 @@ def test_sidecar_gc_follows_orbax_pruning(tmp_path, mesh8):
     assert steps <= {3, 4, 5}    # pruned steps' sidecars are gone
 
 
+def test_step_checkpoint_elastic_under_pipeline(tmp_path, monkeypatch):
+    """--checkpoint-every composes with the SPMD pipeline mode: the chaos
+    hook kills epoch 2 mid-flight (gstep 8 = batch 3 of 5), recovery
+    resumes from the step-7 boundary and the run completes through
+    run_workload."""
+    from distributed_deep_learning_tpu.utils import failures
+    from distributed_deep_learning_tpu.utils.config import parse_args
+    from distributed_deep_learning_tpu.workloads import get_spec
+    from distributed_deep_learning_tpu.workloads.base import run_workload
+
+    monkeypatch.setenv("DDL_DATA_LIMIT", "128")  # 89 train -> 5 steps of 16
+    monkeypatch.setenv("DDL_INJECT_STEP_FAILURE", "0:8")
+    failures._step_injected = False
+    try:
+        config = parse_args(
+            ["-m", "pipeline", "-e", "2", "-b", "16", "-l", "4", "-s", "32",
+             "--nstages", "4", "--elastic",
+             "--checkpoint-dir", str(tmp_path / "ck"),
+             "--checkpoint-every", "2"], workload="bert")
+        _, history = run_workload(get_spec("bert"), config)
+    finally:
+        failures._step_injected = False
+    phases = [h.phase for h in history]
+    assert phases.count("train") == 2 and "test" in phases
+    assert np.isfinite(history[-1].loss)
+
+
 def test_step_failure_injection_validation(monkeypatch):
     from distributed_deep_learning_tpu.utils import failures
 
