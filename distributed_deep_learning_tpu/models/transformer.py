@@ -173,10 +173,14 @@ class MultiHeadAttention(nn.Module):
                 # dense direct: the flash adapter would route this dense
                 # mask to the same path anyway, minus a spurious warning
                 attn = dot_product_attention
-        if kv_heads != self.num_heads:
+        if kv_heads != self.num_heads and \
+                not getattr(attn, "supports_gqa", False):
             # GQA: K/V carry kv_heads (and the KV cache stores only those
             # — the H/kv_heads memory win); expand to full heads for the
-            # attention contraction (XLA fuses the broadcast)
+            # attention contraction (XLA fuses the broadcast).  A
+            # GQA-native implementation (the flash kernel) takes the
+            # unexpanded K/V and maps heads internally — group× less K/V
+            # HBM traffic, which is the other half of the GQA win.
             group = self.num_heads // kv_heads
             k = jnp.repeat(k, group, axis=2)
             v = jnp.repeat(v, group, axis=2)

@@ -162,6 +162,13 @@ def _flash_fwd(q, k, v, kvalid, sm_scale, causal, block_q, block_k,
     Tk = k.shape[1]
     block_q = _fit_block(Tq, block_q)
     block_k = _fit_block(Tk, block_k)
+    # GQA-native (round 5): q rows are (batch, kv_head, group_member)-
+    # ordered, so query program b reads K/V row b // kv_group — the kernel
+    # streams the TRUE (B·Hkv) K/V, never a (B·H) head-expanded copy (the
+    # group× HBM saving is the whole point of grouped-query attention).
+    # kvalid is per-batch, shared by every head: row b // valid_group.
+    kv_group = BH // k.shape[0]
+    valid_group = BH // kvalid.shape[0] if kvalid is not None else 1
     kernel = functools.partial(
         _fwd_kernel if kvalid is not None else drop_kv(_fwd_kernel, 3),
         sm_scale=sm_scale, causal=causal, block_k=block_k, k_len=Tk,
@@ -169,17 +176,18 @@ def _flash_fwd(q, k, v, kvalid, sm_scale, causal, block_q, block_k,
     in_specs = [
         pl.BlockSpec((1, block_q, D), lambda b, qi: (b, qi, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, Tk, D), lambda b, qi: (b, 0, 0),
+        pl.BlockSpec((1, Tk, D), lambda b, qi: (b // kv_group, 0, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, Tk, D), lambda b, qi: (b, 0, 0),
+        pl.BlockSpec((1, Tk, D), lambda b, qi: (b // kv_group, 0, 0),
                      memory_space=pltpu.VMEM),
     ]
     args = [q, k, v]
     if kvalid is not None:
-        # (BH, 1, Tk): the trailing size-1 sublane dim keeps the block
-        # Mosaic-legal (a (1, Tk) block over 2D (BH, Tk) is not)
-        in_specs.append(pl.BlockSpec((1, 1, Tk), lambda b, qi: (b, 0, 0),
-                                     memory_space=pltpu.VMEM))
+        # (B, 1, Tk): the trailing size-1 sublane dim keeps the block
+        # Mosaic-legal (a (1, Tk) block over 2D (B, Tk) is not)
+        in_specs.append(pl.BlockSpec(
+            (1, 1, Tk), lambda b, qi: (b // valid_group, 0, 0),
+            memory_space=pltpu.VMEM))
         args.append(kvalid)
     out, lse = pl.pallas_call(
         kernel,
@@ -284,6 +292,8 @@ def _flash_bwd(q, k, v, kvalid, out, lse, g, sm_scale, causal, block_q,
     Tk = k.shape[1]
     block_q = _fit_block(Tq, block_q)
     block_k = _fit_block(Tk, block_k)
+    kv_group = BH // k.shape[0]  # GQA: K/V rows shared by `group` q heads
+    valid_group = BH // kvalid.shape[0] if kvalid is not None else 1
     # delta = rowsum(dO ⊙ O), precomputed ONCE (plain XLA, fuses with the
     # surrounding graph) and threaded to both kernels like lse — cheaper
     # than streaming O into the kernels and recomputing per key block
@@ -296,13 +306,16 @@ def _flash_bwd(q, k, v, kvalid, out, lse, g, sm_scale, causal, block_q,
                          memory_space=pltpu.VMEM)
     kspec = pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0),
                          memory_space=pltpu.VMEM)
-    kfull = pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0),
+    kfull = pl.BlockSpec((1, Tk, D), lambda b, i: (b // kv_group, 0, 0),
                          memory_space=pltpu.VMEM)
+    kblk_shared = pl.BlockSpec((1, block_k, D),
+                               lambda b, i: (b // kv_group, i, 0),
+                               memory_space=pltpu.VMEM)
     lseblk = pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0),
                           memory_space=pltpu.VMEM)
     lsefull = pl.BlockSpec((1, Tq, 1), lambda b, i: (b, 0, 0),
                            memory_space=pltpu.VMEM)
-    kvfull = pl.BlockSpec((1, 1, Tk), lambda b, i: (b, 0, 0),
+    kvfull = pl.BlockSpec((1, 1, Tk), lambda b, i: (b // valid_group, 0, 0),
                           memory_space=pltpu.VMEM)
 
     # ---- dQ: grid over query blocks -------------------------------------
@@ -325,11 +338,15 @@ def _flash_bwd(q, k, v, kvalid, out, lse, g, sm_scale, causal, block_q,
     )(*dq_args)
 
     # ---- dK/dV (fused): grid over key blocks ----------------------------
+    # GQA: each query-head program computes ITS contribution to the shared
+    # K/V rows' gradients ((BH, Tk, D) partials); the group-sum reduction
+    # to (B·Hkv, Tk, D) happens outside in f32 — group rows are adjacent
+    # by construction (b = kv_row·group + member), so it is one reshape.
     dkv_kernel = functools.partial(
         _dkv_kernel if kvalid is not None else drop_kv(_dkv_kernel, 6),
         sm_scale=sm_scale, causal=causal, block_q=block_q, q_len=Tq,
         window=window)
-    dkv_specs = [qfull, kspec, kspec, qfull, lsefull, lsefull]
+    dkv_specs = [qfull, kblk_shared, kblk_shared, qfull, lsefull, lsefull]
     dkv_args = [q, k, v, g, lse, delta]
     if kvalid is not None:
         dkv_specs.append(kvfull)
@@ -339,10 +356,17 @@ def _flash_bwd(q, k, v, kvalid, out, lse, g, sm_scale, causal, block_q,
         grid=(BH, Tk // block_k),
         in_specs=dkv_specs,
         out_specs=[kspec, kspec],
-        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
-                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((BH, Tk, D), k.dtype),
+                   jax.ShapeDtypeStruct((BH, Tk, D), v.dtype)],
         interpret=interpret,
     )(*dkv_args)
+    if kv_group > 1:
+        def reduce_group(a, dtype):
+            a = a.reshape(k.shape[0], kv_group, Tk, D)
+            return jnp.sum(a.astype(jnp.float32), axis=1).astype(dtype)
+
+        dk = reduce_group(dk, k.dtype)
+        dv = reduce_group(dv, v.dtype)
     return dq, dk, dv
 
 
@@ -411,8 +435,12 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                     block_q: int | None = None, block_k: int | None = None,
                     window: int | None = None,
                     interpret: bool | None = None) -> jnp.ndarray:
-    """Fused attention on ``(B, T, H, D)`` q/k/v (same layout as
-    :func:`..models.transformer.dot_product_attention`).
+    """Fused attention on ``(B, T, H, D)`` q — with ``(B, Tk, Hkv, D)``
+    k/v where ``Hkv`` divides H (GQA/MQA NATIVE, round 5: the kernel maps
+    each query head onto its shared K/V head via the block index maps, so
+    the group×-smaller K/V is what streams from HBM; head-expanded copies
+    are never materialised).  ``Hkv == H`` is ordinary multi-head (same
+    layout as :func:`..models.transformer.dot_product_attention`).
 
     ``key_valid`` is an optional ``(B, Tk)`` boolean padding mask (True =
     attend); invalid keys are masked in-kernel with the same NEG_INF
@@ -439,18 +467,21 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     B, Tq, H, D = q.shape
-    Tk = k.shape[1]
+    Tk, Hkv = k.shape[1], k.shape[2]
+    if H % Hkv:
+        raise ValueError(f"{H} query heads not a multiple of {Hkv} KV "
+                         "heads (GQA groups must be uniform)")
 
     def to_bhtd(x):
         return jnp.swapaxes(x, 1, 2).reshape(B * x.shape[2], x.shape[1], D)
 
     kvalid = None
     if key_valid is not None:
-        # per-batch mask, expanded over heads, shaped (BH, 1, Tk) — the
-        # size-1 sublane dim keeps kernel blocks Mosaic-legal; float so the
-        # custom_vjp can hand back an ordinary zero cotangent
-        kvalid = jnp.repeat(key_valid.astype(jnp.float32), H,
-                            axis=0)[:, None, :]
+        # per-BATCH mask shaped (B, 1, Tk) — the kernels index it with
+        # b // valid_group, so no head expansion is ever materialised; the
+        # size-1 sublane dim keeps kernel blocks Mosaic-legal; float so
+        # the custom_vjp can hand back an ordinary zero cotangent
+        kvalid = key_valid.astype(jnp.float32)[:, None, :]
     out = _flash_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v), kvalid, sm_scale,
                       causal, block_q, block_k, interpret, window)
     return jnp.swapaxes(out.reshape(B, H, Tq, D), 1, 2)
@@ -461,10 +492,13 @@ def make_attention_fn(causal: bool = False, **kw):
     (mirrors :func:`..parallel.ring_attention.make_attention_fn`).
 
     Supports the structured mask convention (``key_valid`` padding masks +
-    a ``causal`` flag).  A pre-built dense ``mask`` tensor — whose (T×T)
-    materialisation is exactly what the kernel avoids — falls back to the
-    dense path for THAT call with a one-time warning (VERDICT r4 item 9),
-    so any ``MultiHeadAttention(mask=...)`` config still trains under
+    a ``causal`` flag) and NATIVE GQA (``attn.supports_gqa``: the layer
+    hands over unexpanded ``Hkv``-headed K/V and the kernel maps query
+    heads onto shared K/V heads — no head-expanded copy in HBM).  A
+    pre-built dense ``mask`` tensor — whose (T×T) materialisation is
+    exactly what the kernel avoids — falls back to the dense path for
+    THAT call with a one-time warning (VERDICT r4 item 9), so any
+    ``MultiHeadAttention(mask=...)`` config still trains under
     ``--attention auto`` instead of crashing.
     """
 
@@ -487,6 +521,11 @@ def make_attention_fn(causal: bool = False, **kw):
             sm = kw.get("sm_scale")
             if sm is not None:
                 q = q * (sm * (q.shape[-1] ** 0.5))
+            if k.shape[2] != q.shape[2]:
+                # the layer skipped GQA expansion for us; dense needs it
+                group = q.shape[2] // k.shape[2]
+                k = jnp.repeat(k, group, axis=2)
+                v = jnp.repeat(v, group, axis=2)
             return dot_product_attention(
                 q, k, v, mask=mask, key_valid=key_valid,
                 causal=causal or forced_causal, window=eff_window,
@@ -497,4 +536,5 @@ def make_attention_fn(causal: bool = False, **kw):
         return flash_attention(q, k, v, causal=causal or forced_causal,
                                key_valid=key_valid, **call_kw).astype(dtype)
 
+    attn.supports_gqa = True
     return attn
