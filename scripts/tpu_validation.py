@@ -24,6 +24,22 @@ def _sync(x):
     return float(jnp.sum(x.astype(jnp.float32)))
 
 
+def _time_grad(scalar_loss, q, steps):
+    """Seconds/step of ``jit(grad(scalar_loss))``: one warmup compile,
+    ``steps`` dispatches, one trailing sync — the SHARED timing protocol,
+    so every section's ms numbers stay comparable (review finding: three
+    diverging copies)."""
+    import jax
+
+    loss = jax.jit(jax.grad(scalar_loss))
+    _sync(loss(q))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        g = loss(q)
+    _sync(g)
+    return (time.perf_counter() - t0) / steps
+
+
 def flash_vs_dense(B=4, T=2048, H=8, D=64, steps=20):
     import jax
     import jax.numpy as jnp
@@ -38,13 +54,7 @@ def flash_vs_dense(B=4, T=2048, H=8, D=64, steps=20):
                for kk in ks)
 
     def bench(fn):
-        loss = jax.jit(jax.grad(lambda q: jnp.sum(fn(q, k, v) ** 2)))
-        _sync(loss(q))
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            g = loss(q)
-        _sync(g)
-        return (time.perf_counter() - t0) / steps
+        return _time_grad(lambda q: jnp.sum(fn(q, k, v) ** 2), q, steps)
 
     td = bench(lambda q, k, v: dot_product_attention(
         q, k, v, causal=True, dtype=jnp.bfloat16))
@@ -142,14 +152,10 @@ def flash_block_sweep(B=4, T=2048, H=8, D=64, steps=10):
     for bq, bk in ((128, 128), (128, 256), (256, 128), (256, 256),
                    (512, 128), (128, 512), (512, 512)):
         try:
-            loss = jax.jit(jax.grad(lambda q: jnp.sum(flash_attention(
-                q, k, v, causal=True, block_q=bq, block_k=bk) ** 2)))
-            _sync(loss(q))
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                g = loss(q)
-            _sync(g)
-            ms = (time.perf_counter() - t0) / steps * 1e3
+            ms = _time_grad(
+                lambda q, bq=bq, bk=bk: jnp.sum(flash_attention(
+                    q, k, v, causal=True, block_q=bq, block_k=bk) ** 2),
+                q, steps) * 1e3
         except Exception as exc:  # a VMEM-overflowing config is a data
             rows.append({"bq": bq, "bk": bk,      # point, not an abort
                          "error": f"{type(exc).__name__}"})
@@ -170,6 +176,33 @@ def flash_block_sweep(B=4, T=2048, H=8, D=64, steps=10):
                      "ms": round(best[2], 3)}}
 
 
+def gqa_speedup(B=4, T=2048, H=8, Hkv=2, D=64, steps=10):
+    """GQA-native vs full-MHA flash at the bench shape: quantifies what
+    the group× K/V HBM saving buys on this chip (the kernel maps query
+    heads onto shared K/V heads in-kernel — round 5)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_deep_learning_tpu.ops.attention_pallas import (
+        flash_attention)
+
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D), jnp.bfloat16)
+
+    def bench(hkv):
+        k = jax.random.normal(ks[1], (B, T, hkv, D), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (B, T, hkv, D), jnp.bfloat16)
+        return _time_grad(lambda q: jnp.sum(flash_attention(
+            q, k, v, causal=True) ** 2), q, steps)
+
+    t_mha = bench(H)
+    t_gqa = bench(Hkv)
+    return {"section": "gqa_speedup", "T": T, "H": H, "Hkv": Hkv,
+            "mha_ms": round(t_mha * 1e3, 3),
+            "gqa_ms": round(t_gqa * 1e3, 3),
+            "speedup": round(t_mha / t_gqa, 3)}
+
+
 def _record_flash_gate(result: dict) -> None:
     """Persist the measured ratio as the `--attention auto` gate datum."""
     from distributed_deep_learning_tpu.utils.bench_records import (
@@ -178,8 +211,8 @@ def _record_flash_gate(result: dict) -> None:
     record_flash_speedup(result["speedup"])
 
 
-SECTIONS = ("flash_block_sweep", "flash_vs_dense", "s2d_vs_plain",
-            "batch_sweep", "lm_tokens")
+SECTIONS = ("flash_block_sweep", "flash_vs_dense", "gqa_speedup",
+            "s2d_vs_plain", "batch_sweep", "lm_tokens")
 
 
 def _run_section(name: str) -> None:
