@@ -47,7 +47,7 @@ SMOKE_FILES = {
     "test_spmd_pipeline.py", "test_mpmd.py", "test_zero.py",
     "test_tensor_parallel.py", "test_ulysses.py", "test_fused_ce.py",
     "test_profiling.py", "test_schedules.py", "test_compress.py",
-    "test_host_pipeline.py",
+    "test_host_pipeline.py", "test_attention_pallas.py",
 }
 
 
