@@ -334,3 +334,36 @@ def test_bench_worker_fails_fast_on_init_error(monkeypatch):
     with pytest.raises(RuntimeError, match="down"):
         bench._devices_or_cpu_fallback()
     assert "spawned" not in called
+
+
+def _load_tpu_validation():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "tpu_validation", os.path.join(REPO, "scripts",
+                                       "tpu_validation.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_validation_sections_run_at_micro_shapes():
+    """The watchdogged TPU validation sections execute end to end on CPU
+    at micro shapes (round 5): the harness the next healthy hardware
+    window depends on must not rot."""
+    tv = _load_tpu_validation()
+    r = tv.gqa_speedup(B=1, T=32, H=4, Hkv=2, D=16, steps=1)
+    assert r["speedup"] > 0 and r["mha_ms"] > 0 and r["gqa_ms"] > 0
+    r = tv.flash_vs_dense(B=1, T=32, H=2, D=16, steps=1)
+    assert r["speedup"] > 0 and r["dense_ms"] > 0
+    r = tv.flash_block_sweep(B=1, T=32, H=2, D=16, steps=1)
+    assert r["best"] is not None and len(r["rows"]) >= 1
+    assert all("ms" in row or "error" in row for row in r["rows"])
+
+
+def test_validation_section_registry_resolves():
+    """Every name in SECTIONS resolves to a callable (the parent spawns
+    children by name via globals())."""
+    tv = _load_tpu_validation()
+    for name in tv.SECTIONS:
+        assert callable(getattr(tv, name)), name
