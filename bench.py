@@ -365,6 +365,31 @@ def _attention_speedup(steps: int = 20) -> float | None:
         return None
 
 
+def _enable_compile_cache() -> None:
+    """Point XLA's persistent compilation cache at a repo-local dir.
+
+    The tunneled transport makes every heavy compile cost 60-90 s; the
+    round-5 window died mid-bench because the worker's five compiles
+    outran its carved budget.  With the cache warm (populated by any
+    prior run on the same shapes — including this session's validation
+    batch), a full worker re-run compiles in seconds, so the driver's
+    end-of-round bench completes inside any window the probe passes.
+    ``BENCH_COMPILE_CACHE=0`` opts out."""
+    if os.environ.get("BENCH_COMPILE_CACHE", "1") == "0":
+        return
+    try:
+        import jax
+
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as exc:  # cache is an optimisation, never a blocker
+        print(f"bench: compile cache unavailable ({type(exc).__name__})",
+              file=sys.stderr)
+
+
 def _time_left() -> float:
     """Seconds until the orchestrator's soft deadline (inf when unset).
 
@@ -388,6 +413,25 @@ def _vs_baseline(baselines: dict, key: str, value: float,
 
 
 def main() -> None:
+    _enable_compile_cache()
+    section_secs: dict[str, float] = {}
+
+    class _section_timer:
+        """Record a section's wall time (stderr + the JSON line) so a
+        timed-out attempt leaves a diagnosis, not a mystery (the round-5
+        window was lost to exactly that)."""
+
+        def __init__(self, name: str) -> None:
+            self.name = name
+
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+
+        def __exit__(self, *exc):
+            section_secs[self.name] = round(time.perf_counter() - self.t0, 1)
+            print(f"bench: section {self.name} took "
+                  f"{section_secs[self.name]}s", file=sys.stderr)
+
     if os.environ.get("BENCH_CPU_FALLBACK") == "1":
         # env vars alone don't unpin a site-registered platform; the
         # jax.config route works pre-backend-init (tests/conftest.py)
@@ -427,9 +471,10 @@ def main() -> None:
     # space-to-depth stem (mathematically-equivalent 4x4-s1 packed conv,
     # models/resnet.py) is the TPU default; BENCH_S2D=0 reverts
     s2d = on_tpu and os.environ.get("BENCH_S2D", "1") != "0"
-    ips, flops_per_step = _train_throughput(
-        resnet50(dtype=dtype, stem_s2d=s2d), image_size=224,
-        num_classes=1000, batch=batch, steps=steps, mesh=mesh)
+    with _section_timer("headline"):
+        ips, flops_per_step = _train_throughput(
+            resnet50(dtype=dtype, stem_s2d=s2d), image_size=224,
+            num_classes=1000, batch=batch, steps=steps, mesh=mesh)
 
     mfu = flops_per_image = None
     peak = chip_peak_flops(device_kind) if on_tpu else None
@@ -451,8 +496,16 @@ def main() -> None:
     # must print even if a secondary model OOMs, hits a compile bug, or a
     # degraded transport slows it down (their absence reads as null).
     # --- secondary: the reference's flagship (DenseNet-BC, PCB 64x64) ------
+    # Shed thresholds are MEASURED cold-compile worst cases from the
+    # round-5 hardware window (validation log timestamps: ResNet compile
+    # ~90s over the tunnel, LM section ~200s, input ~250s with JPEG
+    # tree).  They gate on on_tpu: CPU sections compile in seconds, and
+    # the guaranteed CPU fallback attempt (240-300 s budget) must not
+    # shed data it can easily afford.
+    t_secondary, t_lm, t_input = (150, 300, 250) if on_tpu else (60, 120, 60)
     secondary = None
-    if os.environ.get("BENCH_SECONDARY", "1") != "0" and _time_left() < 120:
+    if os.environ.get("BENCH_SECONDARY", "1") != "0" and \
+            _time_left() < t_secondary:
         print(f"bench: shedding densenet section ({_time_left():.0f}s left)",
               file=sys.stderr)
     elif os.environ.get("BENCH_SECONDARY", "1") != "0":
@@ -461,9 +514,10 @@ def main() -> None:
                                         1024 * n_chips if on_tpu else 16))
             dsteps = int(os.environ.get("BENCH_DENSENET_STEPS",
                                         30 if on_tpu else 2))
-            dips, _ = _train_throughput(
-                _flagship(dtype=dtype), image_size=64, num_classes=6,
-                batch=dbatch, steps=dsteps, mesh=mesh)
+            with _section_timer("densenet"):
+                dips, _ = _train_throughput(
+                    _flagship(dtype=dtype), image_size=64, num_classes=6,
+                    batch=dbatch, steps=dsteps, mesh=mesh)
             dvs = _vs_baseline(baselines,
                                f"{platform}:densenet_bc_train_v2",
                                dips, base_path)
@@ -477,7 +531,7 @@ def main() -> None:
     # --- LM: decoder-only transformer, flash attention + fused CE head -----
     lm = None
     if os.environ.get("BENCH_LM", "1" if on_tpu else "0") != "0" and \
-            _time_left() < 180:
+            _time_left() < t_lm:
         print(f"bench: shedding lm section ({_time_left():.0f}s left)",
               file=sys.stderr)
     elif os.environ.get("BENCH_LM", "1" if on_tpu else "0") != "0":
@@ -488,9 +542,10 @@ def main() -> None:
                                       2048 if on_tpu else 128))
             lsteps = int(os.environ.get("BENCH_LM_STEPS",
                                         10 if on_tpu else 2))
-            ltps, lflops = _lm_throughput(batch=lbatch, seq_len=lseq,
-                                          steps=lsteps, mesh=mesh,
-                                          dtype=dtype)
+            with _section_timer("lm"):
+                ltps, lflops = _lm_throughput(batch=lbatch, seq_len=lseq,
+                                              steps=lsteps, mesh=mesh,
+                                              dtype=dtype)
             lvs = _vs_baseline(baselines,
                                f"{platform}:causal_lm_2048_train_v1",
                                ltps, base_path)
@@ -506,23 +561,25 @@ def main() -> None:
 
     # --- host input pipeline on the measured path --------------------------
     input_pipe = None
-    if os.environ.get("BENCH_INPUT", "1") != "0" and _time_left() < 100:
+    if os.environ.get("BENCH_INPUT", "1") != "0" and _time_left() < t_input:
         print(f"bench: shedding input-pipeline section ({_time_left():.0f}s "
               "left)", file=sys.stderr)
     elif os.environ.get("BENCH_INPUT", "1") != "0":
         try:
-            input_pipe = _input_pipeline(mesh=mesh, dtype=dtype)
+            with _section_timer("input_pipeline"):
+                input_pipe = _input_pipeline(mesh=mesh, dtype=dtype)
         except Exception as exc:
             print(f"bench: input-pipeline section failed "
                   f"({type(exc).__name__}: {exc})", file=sys.stderr)
 
     attn_speedup = None
     if on_tpu and os.environ.get("BENCH_ATTENTION", "1") != "0":
-        if _time_left() < 75:
+        if _time_left() < 90:
             print(f"bench: shedding attention micro ({_time_left():.0f}s "
                   "left)", file=sys.stderr)
         else:
-            attn_speedup = _attention_speedup()
+            with _section_timer("attention"):
+                attn_speedup = _attention_speedup()
     if attn_speedup is not None:
         # latest-wins decision datum: workloads' `--attention auto` gates
         # the TPU flash default on this recorded ratio (northstar.py)
@@ -531,7 +588,7 @@ def main() -> None:
 
         record_flash_speedup(attn_speedup)
 
-    print(json.dumps({
+    line = {
         "metric": f"resnet50_224 bf16 train images/sec/chip ({platform})",
         "value": round(ips, 2),
         "unit": "images/sec/chip",
@@ -544,7 +601,18 @@ def main() -> None:
         "input_pipeline": input_pipe,
         "flash_attention_speedup":
             round(attn_speedup, 3) if attn_speedup else None,
-    }))
+        "section_secs": section_secs,
+    }
+    if not on_tpu:
+        # CPU fallback: carry the RECORDED hardware history (labelled as
+        # such — these are prior measured baselines from
+        # bench_baseline.json, not this run) so a dead-transport round
+        # still reports the chip numbers it has already earned.
+        recorded = {k: v for k, v in baselines.items()
+                    if k.startswith("tpu:")}
+        if recorded:
+            line["recorded_tpu"] = recorded
+    print(json.dumps(line))
 
 
 def orchestrate() -> int:
@@ -634,12 +702,17 @@ def orchestrate() -> int:
     # --- accelerator attempts, batch backing off on failure ----------------
     pinned = "BENCH_BATCH" in os.environ or \
         "BENCH_BATCH_PER_CHIP" in os.environ
+    # Retries shed the optional sections up front (round-5 lesson: after a
+    # 720 s first-attempt timeout only ~170 s remained — a full section
+    # set can never fit, but headline-only with a warm compile cache can).
+    shed = {"BENCH_SECONDARY": "0", "BENCH_LM": "0", "BENCH_INPUT": "0"}
     plan: list[dict] = [{}] if pinned else [
         {"BENCH_BATCH_PER_CHIP": "256"},
-        {"BENCH_BATCH_PER_CHIP": "128"},
+        {"BENCH_BATCH_PER_CHIP": "128", **shed},
         # insurance against a TPU-specific s2d-stem compile failure: one
         # attempt with the plain 7x7 stem before giving up the chip
-        {"BENCH_BATCH_PER_CHIP": "128", "BENCH_S2D": "0"},
+        {"BENCH_BATCH_PER_CHIP": "128", "BENCH_S2D": "0", **shed,
+         "BENCH_ATTENTION": "0"},
     ]
     failures = 0
     for extra in plan:

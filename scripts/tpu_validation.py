@@ -218,6 +218,11 @@ SECTIONS = ("flash_block_sweep", "flash_vs_dense", "gqa_speedup",
 def _run_section(name: str) -> None:
     import jax
 
+    from bench import _enable_compile_cache
+
+    # persistent XLA cache: a re-harvest after a transport drop (or the
+    # driver's bench that follows) skips the 60-90 s tunnel compiles
+    _enable_compile_cache()
     if os.environ.get("TPU_VALIDATION_CPU") == "1":
         # CPU smoke: the env var alone is not enough when a site plugin
         # pins the platform — force via jax.config pre-backend-init

@@ -306,6 +306,66 @@ def test_bench_cpu_attempt_strips_batch_pins(monkeypatch):
     assert calls[-1] == (None, "1")
 
 
+def test_bench_retry_attempts_shed_optional_sections(monkeypatch):
+    """Round-5 regression: after a first-attempt timeout only the CPU
+    reserve's leftovers remain — retries must spend it on the headline,
+    not on DenseNet/LM/input sections that cannot fit."""
+    import bench
+
+    calls = []
+
+    def fake_run(cmd, env=None, timeout=None, **kw):
+        calls.append({k: env.get(k) for k in
+                      ("BENCH_BATCH_PER_CHIP", "BENCH_SECONDARY",
+                       "BENCH_LM", "BENCH_INPUT", "BENCH_CPU_FALLBACK")})
+        if env.get("BENCH_CPU_FALLBACK") == "1":
+            class R:
+                returncode = 0
+                stdout = '{"metric": "m", "value": 1}\n'
+            return R()
+        if env.get("BENCH_BATCH_PER_CHIP") == "256":
+            raise subprocess.TimeoutExpired(cmd, timeout)
+
+        class R:
+            returncode = 0
+            stdout = '{"metric": "m", "value": 3}\n'
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", _probe_aware(fake_run))
+    monkeypatch.delenv("BENCH_BATCH", raising=False)
+    monkeypatch.delenv("BENCH_BATCH_PER_CHIP", raising=False)
+    assert bench.orchestrate() == 0
+    # the full-section first attempt timed out; the retry sheds extras
+    assert calls[0]["BENCH_SECONDARY"] is None
+    assert calls[1]["BENCH_BATCH_PER_CHIP"] == "128"
+    assert calls[1]["BENCH_SECONDARY"] == "0"
+    assert calls[1]["BENCH_LM"] == "0"
+    assert calls[1]["BENCH_INPUT"] == "0"
+
+
+def test_bench_compile_cache_config(monkeypatch):
+    """_enable_compile_cache points XLA's persistent cache at the
+    repo-local dir (so repeat bench runs skip the 60-90 s tunnel
+    compiles) and BENCH_COMPILE_CACHE=0 opts out."""
+    import bench
+
+    seen = {}
+    monkeypatch.setattr(
+        jax.config, "update",
+        lambda k, v: seen.__setitem__(k, v))
+    # hermetic: no .jax_cache dir creation in the source tree
+    monkeypatch.setattr(os, "makedirs", lambda *a, **k: None)
+    monkeypatch.delenv("BENCH_COMPILE_CACHE", raising=False)
+    bench._enable_compile_cache()
+    assert seen["jax_compilation_cache_dir"].endswith(".jax_cache")
+    assert seen["jax_persistent_cache_min_compile_time_secs"] == 1.0
+
+    seen.clear()
+    monkeypatch.setenv("BENCH_COMPILE_CACHE", "0")
+    bench._enable_compile_cache()
+    assert seen == {}
+
+
 def test_bench_worker_sheds_sections_past_deadline(monkeypatch):
     import time as _t
 
