@@ -69,13 +69,11 @@ def _devices_or_cpu_fallback():
             [sys.executable, os.path.abspath(__file__)], env=env))
 
 
-def _train_throughput(model, *, image_size, num_classes, batch, steps, mesh):
-    """images/sec/chip + FLOPs/step for one jitted train step of ``model``.
+def _build_train_step(model, *, image_size, num_classes, batch, mesh):
+    """The EXACT headline train-step setup: (train_step, state, x, y).
 
-    Sync via a host scalar fetch, NOT ``block_until_ready``: under tunneled
-    device transports (axon) ``block_until_ready`` can return before the
-    device work drains, flattering the clock by orders of magnitude; a
-    device-to-host scalar read is an unfakeable end-to-end barrier.
+    Shared by the timing loop and the mfu_diag cost probe so the roofline
+    numbers describe the same compiled program the throughput came from.
     """
     import jax
     import jax.numpy as jnp
@@ -90,7 +88,6 @@ def _train_throughput(model, *, image_size, num_classes, batch, steps, mesh):
     from distributed_deep_learning_tpu.train.step import (make_step_fns,
                                                           place_state)
 
-    n_chips = len(mesh.devices.flatten())
     rng = np.random.default_rng(42)
     x = jnp.asarray(rng.standard_normal(
         (batch, image_size, image_size, 3), dtype=np.float32))
@@ -103,18 +100,46 @@ def _train_throughput(model, *, image_size, num_classes, batch, steps, mesh):
     train_step, _ = make_step_fns(mesh, cross_entropy_loss)
     sh = NamedSharding(mesh, P(BATCH_AXES))
     x, y = jax.device_put(x, sh), jax.device_put(y, sh)
+    return train_step, state, x, y
 
+
+def _train_throughput(model, *, image_size, num_classes, batch, steps, mesh):
+    """images/sec/chip + FLOPs/step for one jitted train step of ``model``.
+
+    Sync via a host scalar fetch, NOT ``block_until_ready``: under tunneled
+    device transports (axon) ``block_until_ready`` can return before the
+    device work drains, flattering the clock by orders of magnitude; a
+    device-to-host scalar read is an unfakeable end-to-end barrier.
+    """
+    n_chips = len(mesh.devices.flatten())
+    train_step, state, x, y = _build_train_step(
+        model, image_size=image_size, num_classes=num_classes, batch=batch,
+        mesh=mesh)
+    return _timed_steps(train_step, state, x, y, steps=steps,
+                        n_chips=n_chips, batch=batch)
+
+
+def _cost_analysis(compiled) -> dict:
+    """Normalise ``compiled.cost_analysis()`` across JAX versions (dict,
+    list-of-dicts, or None) — shared by the timing loop and mfu_diag."""
+    analysis = compiled.cost_analysis()
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else {}
+    return analysis or {}
+
+
+def _timed_steps(train_step, state, x, y, *, steps, n_chips, batch):
+    """Time ``steps`` dispatches of ``train_step``; see _train_throughput
+    for the host-fetch sync rationale."""
     # AOT-compile once: the same executable serves cost_analysis AND the
     # timing loop (lower().compile() does not seed jit's dispatch cache, so
     # calling the jitted fn after it would compile a second time)
     step, flops_per_step = train_step, None
     try:
         compiled = train_step.lower(state, x, y).compile()
-        analysis = compiled.cost_analysis()
-        if isinstance(analysis, (list, tuple)):
-            analysis = analysis[0] if analysis else {}
         # per-device module FLOPs x device count = whole-step FLOPs
-        flops_per_step = float(analysis.get("flops", 0.0)) * n_chips or None
+        flops_per_step = float(
+            _cost_analysis(compiled).get("flops", 0.0)) * n_chips or None
         step = compiled
     except Exception:
         pass  # cost model unavailable on this backend; mfu reported as null
@@ -705,14 +730,14 @@ def orchestrate() -> int:
     # Retries shed the optional sections up front (round-5 lesson: after a
     # 720 s first-attempt timeout only ~170 s remained — a full section
     # set can never fit, but headline-only with a warm compile cache can).
-    shed = {"BENCH_SECONDARY": "0", "BENCH_LM": "0", "BENCH_INPUT": "0"}
+    shed = {"BENCH_SECONDARY": "0", "BENCH_LM": "0", "BENCH_INPUT": "0",
+            "BENCH_ATTENTION": "0"}
     plan: list[dict] = [{}] if pinned else [
         {"BENCH_BATCH_PER_CHIP": "256"},
         {"BENCH_BATCH_PER_CHIP": "128", **shed},
         # insurance against a TPU-specific s2d-stem compile failure: one
         # attempt with the plain 7x7 stem before giving up the chip
-        {"BENCH_BATCH_PER_CHIP": "128", "BENCH_S2D": "0", **shed,
-         "BENCH_ATTENTION": "0"},
+        {"BENCH_BATCH_PER_CHIP": "128", "BENCH_S2D": "0", **shed},
     ]
     failures = 0
     for extra in plan:

@@ -203,6 +203,62 @@ def gqa_speedup(B=4, T=2048, H=8, Hkv=2, D=64, steps=10):
             "speedup": round(t_mha / t_gqa, 3)}
 
 
+def mfu_diag(batches=(128, 256)):
+    """Roofline diagnosis of the headline step (VERDICT r4 #3: 29.6% MFU
+    needs either a fix or a written analysis).  Pulls XLA ``cost_analysis``
+    on the EXACT compiled train step: FLOPs, bytes accessed, arithmetic
+    intensity, and the roofline-implied MFU ceiling for this chip
+    (peak_flops / hbm_bw ridge point ≈ 240 FLOPs/byte on v5e)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _build_train_step, chip_peak_flops
+    from distributed_deep_learning_tpu.models.resnet import resnet50
+    from distributed_deep_learning_tpu.runtime.mesh import build_mesh
+
+    devices = jax.devices()
+    mesh = build_mesh({"data": len(devices)})
+    on_tpu = devices[0].platform == "tpu"
+    peak = chip_peak_flops(devices[0].device_kind) if on_tpu else None
+    # v5e/v5p/v4 HBM GB/s by device_kind substring (public chip specs)
+    hbm = None
+    kind = devices[0].device_kind.lower()
+    for sub, bw in (("v6", 1640e9), ("v5 lite", 819e9), ("v5e", 819e9),
+                    ("v5p", 2765e9), ("v5", 2765e9), ("v4", 1228e9)):
+        if sub in kind:
+            hbm = bw
+            break
+    from bench import _cost_analysis
+
+    rows = []
+    for batch in batches:
+        try:  # a failing batch (256/chip can OOM) is a data point, not
+            step, state, x, y = _build_train_step(  # an abort — keep the
+                resnet50(dtype=jnp.bfloat16 if on_tpu else jnp.float32,  # rows
+                         stem_s2d=on_tpu), image_size=224,  # already earned
+                num_classes=1000, batch=batch * len(devices), mesh=mesh)
+            analysis = _cost_analysis(step.lower(state, x, y).compile())
+        except Exception as exc:
+            rows.append({"per_chip_batch": batch,
+                         "error": f"{type(exc).__name__}: {exc}"})
+            continue
+        flops = float(analysis.get("flops", 0.0))
+        byt = float(analysis.get("bytes accessed", 0.0))
+        ai = flops / byt if byt else None
+        row = {"per_chip_batch": batch, "flops": flops,
+               "bytes_accessed": byt,
+               "arith_intensity": round(ai, 1) if ai else None}
+        if ai and peak and hbm:
+            ridge = peak / hbm
+            # roofline ceiling: HBM-bound below the ridge point
+            row["ridge_flops_per_byte"] = round(ridge, 1)
+            row["roofline_mfu_ceiling"] = round(
+                min(1.0, ai / ridge), 3)
+        rows.append(row)
+    return {"section": "mfu_diag", "device": devices[0].device_kind,
+            "rows": rows}
+
+
 def _record_flash_gate(result: dict) -> None:
     """Persist the measured ratio as the `--attention auto` gate datum."""
     from distributed_deep_learning_tpu.utils.bench_records import (
@@ -212,7 +268,7 @@ def _record_flash_gate(result: dict) -> None:
 
 
 SECTIONS = ("flash_block_sweep", "flash_vs_dense", "gqa_speedup",
-            "s2d_vs_plain", "batch_sweep", "lm_tokens")
+            "s2d_vs_plain", "batch_sweep", "lm_tokens", "mfu_diag")
 
 
 def _run_section(name: str) -> None:
