@@ -158,9 +158,17 @@ def _timed_steps(train_step, state, x, y, *, steps, n_chips, batch):
     return batch * steps / dt / n_chips, flops_per_step
 
 
-def _lm_throughput(*, batch, seq_len, steps, mesh, dtype):
+def _lm_throughput(*, batch, seq_len, steps, mesh, dtype, remat=False,
+                   vocab_size=32768, num_layers=12, d_model=768,
+                   num_heads=12, mlp_dim=3072):
     """tokens/sec/chip + FLOPs/step for a CausalLM train step (flash
-    attention + fused linear-cross-entropy head, weight-tied)."""
+    attention + fused linear-cross-entropy head, weight-tied).
+
+    ``remat=True`` wraps the forward in ``jax.checkpoint`` (the same
+    whole-forward policy as ``train.step.make_step_fns(remat=True)``):
+    ~⅓ more FLOPs buys the activation memory back, so larger per-chip
+    batches fit — the lm_sweep validation section measures whether the
+    trade raises MFU at T=2048 like the playbook predicts."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -174,11 +182,12 @@ def _lm_throughput(*, batch, seq_len, steps, mesh, dtype):
 
     n_chips = len(mesh.devices.flatten())
     on_tpu = mesh.devices.flatten()[0].platform == "tpu"
-    model = CausalLM(vocab_size=32768, num_layers=12, d_model=768,
-                     num_heads=12, mlp_dim=3072, dtype=dtype,
+    model = CausalLM(vocab_size=vocab_size, num_layers=num_layers,
+                     d_model=d_model, num_heads=num_heads, mlp_dim=mlp_dim,
+                     dtype=dtype,
                      attention_fn=make_attention_fn() if on_tpu else None)
     rng = np.random.default_rng(7)
-    toks = jnp.asarray(rng.integers(1, 32768, (batch, seq_len + 1)),
+    toks = jnp.asarray(rng.integers(1, vocab_size, (batch, seq_len + 1)),
                        jnp.int32)
 
     params = model.init(jax.random.key(0), toks[:1, :-1])
@@ -190,6 +199,8 @@ def _lm_throughput(*, batch, seq_len, steps, mesh, dtype):
             h = model.apply(p, toks[:, :-1], train=True)
             return model.loss(p, h, toks[:, 1:])
 
+        if remat:
+            loss_fn = jax.checkpoint(loss_fn)
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state2 = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state2, loss
@@ -205,10 +216,8 @@ def _lm_throughput(*, batch, seq_len, steps, mesh, dtype):
     run = jstep
     try:
         compiled = jstep.lower(params, opt_state, toks).compile()
-        analysis = compiled.cost_analysis()
-        if isinstance(analysis, (list, tuple)):
-            analysis = analysis[0] if analysis else {}
-        flops_per_step = float(analysis.get("flops", 0.0)) * n_chips or None
+        flops_per_step = float(
+            _cost_analysis(compiled).get("flops", 0.0)) * n_chips or None
         run = compiled
     except Exception:
         pass

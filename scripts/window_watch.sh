@@ -1,7 +1,10 @@
 #!/bin/bash
-# Probe the tunneled TPU every ~4 min; on the first healthy probe, run
-# the orchestrated bench (populates the compile cache + lands a TPU
-# line if the window holds). Exits after one harvest attempt.
+# Probe the tunneled TPU every ~4 min; on the first healthy probe,
+# harvest in safety order: (1) tpu_validation.py — each section runs in
+# its own watchdogged subprocess and logs incrementally, so a window
+# that dies mid-harvest still keeps every completed section (and its
+# compiles land in .jax_cache); (2) the orchestrated bench on the now-
+# warm cache, whose full section set then fits the first 720s attempt.
 cd /root/repo
 for i in $(seq 1 200); do
   if timeout 90 python -c "
@@ -9,8 +12,10 @@ import jax, jax.numpy as jnp
 x = jnp.ones((128,128), jnp.bfloat16)
 assert float(jnp.sum(x@x)) > 0" 2>/dev/null; then
     echo "$(date -u +%H:%M:%S) probe OK — harvesting" >> bench_r5_harvest.log
+    python scripts/tpu_validation.py >> bench_r5_harvest.log 2>&1
+    echo "validation rc=$?" >> bench_r5_harvest.log
     python bench.py >> bench_r5_harvest.log 2>&1
-    echo "harvest rc=$?" >> bench_r5_harvest.log
+    echo "bench rc=$?" >> bench_r5_harvest.log
     exit 0
   fi
   echo "$(date -u +%H:%M:%S) probe $i dead" >> bench_r5_harvest.log

@@ -421,6 +421,60 @@ def test_validation_sections_run_at_micro_shapes():
     assert all("ms" in row or "error" in row for row in r["rows"])
 
 
+def test_lm_throughput_remat_micro():
+    """The lm_sweep remat rows ride _lm_throughput(remat=True): the
+    jax.checkpoint wrapping must compile and run (micro shape, CPU)."""
+    import jax.numpy as jnp
+
+    import bench
+    from distributed_deep_learning_tpu.runtime.mesh import build_mesh
+
+    mesh = build_mesh({"data": len(jax.devices())})
+    tps, fps = bench._lm_throughput(batch=len(jax.devices()), seq_len=16,
+                                    steps=1, mesh=mesh, dtype=jnp.float32,
+                                    remat=True, vocab_size=128,
+                                    num_layers=2, d_model=32, num_heads=2,
+                                    mlp_dim=64)
+    assert tps > 0
+    assert fps is None or fps > 0
+
+
+def test_lm_sweep_mfu_vs_hfu_bookkeeping(monkeypatch, capsys):
+    """Remat rows must compute MFU from the non-remat model FLOPs/token
+    (cost_analysis on a remat program counts the recompute — that's HFU),
+    print one JSON line per completed row, and keep full exception text
+    for failed configs."""
+    import bench
+
+    tv = _load_tpu_validation()
+
+    ndev = len(jax.devices())
+
+    def fake_lm(*, batch, seq_len, steps, mesh, dtype, remat=False, **kw):
+        if batch >= 64 * ndev:
+            raise RuntimeError("RESOURCE_EXHAUSTED: 17.2G of 16.0G hbm")
+        # 100 FLOPs/token model cost; remat programs report 1.33x
+        return 1000.0, batch * seq_len * (133.0 if remat else 100.0)
+
+    monkeypatch.setattr(tv, "_lm_throughput", fake_lm, raising=False)
+    # lm_sweep imports from bench inside the function body
+    monkeypatch.setattr(bench, "_lm_throughput", fake_lm)
+    monkeypatch.setattr(bench, "chip_peak_flops", lambda kind: 1e6)
+
+    out = tv.lm_sweep(configs=((16, False), (32, True), (64, True)),
+                      seq=128, steps=1)
+    lines = [json.loads(l) for l in
+             capsys.readouterr().out.strip().splitlines()]
+    assert out["rows_completed"] == 2
+    rows = {(l["per_chip_batch"], l["remat"]): l for l in lines}
+    # non-remat MFU from its own FLOPs; remat MFU from the non-remat
+    # cost, with the inflated recompute count relegated to hfu
+    assert rows[(16, False)]["mfu"] == pytest.approx(0.1)
+    assert rows[(32, True)]["mfu"] == pytest.approx(0.1)
+    assert rows[(32, True)]["hfu"] == pytest.approx(0.133)
+    assert "RESOURCE_EXHAUSTED" in rows[(64, True)]["error"]
+
+
 def test_validation_section_registry_resolves():
     """Every name in SECTIONS resolves to a callable (the parent spawns
     children by name via globals())."""
