@@ -1,0 +1,53 @@
+"""Long context via sequence parallelism: ring attention over a `seq` axis.
+
+The sequence dimension is sharded across devices; K/V blocks rotate
+around the ring (`ppermute` over ICI on real hardware) while each device
+folds visiting blocks into a running online softmax — exact attention,
+O(T/S) memory per device, no T x T materialisation anywhere.
+
+    python examples/02_long_context_ring_attention.py          # 2x4 emulated mesh
+    python examples/02_long_context_ring_attention.py --tpu    # the machine's chips
+
+Swap `ring_attention` for `ulysses_attention` (same call shape) to use
+all-to-all head resharding instead; both accept `causal`, `window`, and
+a `key_valid` padding mask that rides the ring / all-to-alls.
+"""
+
+import os
+import sys
+
+if "--tpu" not in sys.argv:
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if "--tpu" not in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+
+from distributed_deep_learning_tpu.parallel.ring_attention import (
+    full_attention, ring_attention)
+from distributed_deep_learning_tpu.runtime.mesh import build_mesh
+
+
+def main():
+    n = len(jax.devices())
+    seq_size = max(n // 2, 1)           # e.g. 8 devices -> data=2 x seq=4
+    mesh = build_mesh({"data": n // seq_size, "seq": seq_size})
+
+    B, T, H, D = 2, 4096, 8, 64         # T shards over `seq`: T/S per device
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.float32) for kk in ks)
+
+    out = ring_attention(q, k, v, mesh=mesh, causal=True)
+    ref = full_attention(q, k, v, causal=True)   # single-device O(T^2) check
+    err = float(jnp.max(jnp.abs(out - ref)))
+    print(f"mesh={dict(mesh.shape)}  T={T}  max|ring - dense| = {err:.2e}")
+    assert err < 1e-4
+
+
+if __name__ == "__main__":
+    main()

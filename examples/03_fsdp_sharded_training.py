@@ -1,0 +1,69 @@
+"""FSDP (ZeRO-3) in three lines: same step fns, a sharded state spec.
+
+The core recipe of this framework (and of TPU programming generally):
+pick a mesh, annotate shardings, let XLA insert the collectives.  The
+train step body is IDENTICAL to pure data parallelism — only the
+``state_spec`` changes, and XLA turns it into the all-gather /
+reduce-scatter dataflow FSDP describes.
+
+    python examples/03_fsdp_sharded_training.py          # 8 emulated devices
+    python examples/03_fsdp_sharded_training.py --tpu    # the machine's chips
+
+Swap `fsdp_state_spec` for `zero1_state_spec` to shard only the
+optimizer state (ZeRO-1).  Both compose with the `data` axis for hybrid
+sharding and are what the CLI's `--zero {1,fsdp}` flag wires.
+"""
+
+import os
+import sys
+
+if "--tpu" not in sys.argv:
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if "--tpu" not in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import optax
+
+from distributed_deep_learning_tpu.models.mlp import MLP
+from distributed_deep_learning_tpu.parallel.zero import fsdp_state_spec
+from distributed_deep_learning_tpu.runtime.mesh import build_mesh
+from distributed_deep_learning_tpu.train.objectives import cross_entropy_loss
+from distributed_deep_learning_tpu.train.state import create_train_state
+from distributed_deep_learning_tpu.train.step import make_step_fns, place_state
+
+
+def main():
+    mesh = build_mesh({"fsdp": len(jax.devices())})
+
+    # a deliberately wide MLP so parameter shards are non-trivial
+    model = MLP(hidden_size=1024, num_hidden_layers=4, num_classes=5)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 48)).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 64)]
+
+    state = create_train_state(model, jax.random.key(0), x[:1],
+                               optax.adamw(1e-3))
+    spec = fsdp_state_spec(state, mesh)          # <- the whole difference
+    state = place_state(state, mesh, spec)
+    train_step, _ = make_step_fns(mesh, cross_entropy_loss, state_spec=spec)
+
+    losses = []
+    for _ in range(10):
+        state, metrics = train_step(state, x, y)
+        losses.append(float(metrics["loss"]))
+
+    # the LARGEST leaf: small leaves (biases) stay replicated by design
+    big = max(jax.tree_util.tree_leaves(state.params), key=lambda l: l.size)
+    print(f"largest param leaf {big.shape} spec: {big.sharding.spec}")
+    print(f"loss: {losses[0]:.4f} -> {losses[-1]:.4f}")
+    assert losses[-1] < losses[0], "did not learn"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""The examples/ scripts must stay runnable — they are the documented
+on-ramp (each asserts its own learning/parity condition internally)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = sorted(
+    f for f in os.listdir(os.path.join(REPO, "examples"))
+    if f.endswith(".py"))
+
+
+def test_examples_inventory_complete():
+    assert len(EXAMPLES) >= 3
+
+
+@pytest.mark.parametrize("script", EXAMPLES)
+def test_example_runs_green(script):
+    # examples force the emulated-CPU mesh themselves (no --tpu here);
+    # a fresh env keeps the suite's XLA_FLAGS from leaking in
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script)],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
