@@ -14,20 +14,8 @@ demonstrates real sharding + the fused gradient psum; `--tpu` lets the
 mesh span the machine's accelerators instead.
 """
 
-import os
-import sys
-
-if "--tpu" not in sys.argv:
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
-                               " --xla_force_host_platform_device_count=8")
-# runnable from a source checkout without installation
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
+import _bootstrap  # noqa: F401  (must precede jax import)
 import jax
-
-if "--tpu" not in sys.argv:
-    # config route, not the env var: site plugins can pin the platform
-    jax.config.update("jax_platforms", "cpu")
 import optax
 
 from distributed_deep_learning_tpu.data.datasets import synthetic_mqtt

@@ -13,18 +13,8 @@ all-to-all head resharding instead; both accept `causal`, `window`, and
 a `key_valid` padding mask that rides the ring / all-to-alls.
 """
 
-import os
-import sys
-
-if "--tpu" not in sys.argv:
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
-                               " --xla_force_host_platform_device_count=8")
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
+import _bootstrap  # noqa: F401  (must precede jax import)
 import jax
-
-if "--tpu" not in sys.argv:
-    jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
 

@@ -14,18 +14,8 @@ optimizer state (ZeRO-1).  Both compose with the `data` axis for hybrid
 sharding and are what the CLI's `--zero {1,fsdp}` flag wires.
 """
 
-import os
-import sys
-
-if "--tpu" not in sys.argv:
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
-                               " --xla_force_host_platform_device_count=8")
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
+import _bootstrap  # noqa: F401  (must precede jax import)
 import jax
-
-if "--tpu" not in sys.argv:
-    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import optax

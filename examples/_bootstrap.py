@@ -1,0 +1,26 @@
+"""Shared example bootstrap: import FIRST, before anything touches jax.
+
+Default: emulate an 8-device mesh on CPU so every example demonstrates
+real sharding on any machine.  `--tpu` on the command line skips the
+emulation and lets the mesh span the machine's accelerators.
+
+The CPU forcing uses the jax.config route, not the JAX_PLATFORMS env
+var: site plugins (e.g. a TPU-tunnel sitecustomize) can pin the platform
+over the env var, but config updates before first device use win.
+"""
+
+import os
+import sys
+
+USE_TPU = "--tpu" in sys.argv
+
+if not USE_TPU:
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8")
+# runnable from a source checkout without installation
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402  (env above must precede the first jax import)
+
+if not USE_TPU:
+    jax.config.update("jax_platforms", "cpu")

@@ -10,7 +10,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = sorted(
     f for f in os.listdir(os.path.join(REPO, "examples"))
-    if f.endswith(".py"))
+    if f.endswith(".py") and not f.startswith("_"))
 
 
 def test_examples_inventory_complete():
