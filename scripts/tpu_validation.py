@@ -306,6 +306,11 @@ def mfu_diag(batches=(128, 256)):
         row = {"per_chip_batch": batch, "flops": flops,
                "bytes_accessed": byt,
                "arith_intensity": round(ai, 1) if ai else None}
+        opt_s = float(analysis.get("optimal_seconds", 0.0))
+        if opt_s and peak:
+            # XLA's own roofline estimate -> the MFU it thinks is possible
+            row["xla_optimal_seconds"] = opt_s
+            row["xla_implied_mfu"] = round(flops / opt_s / peak, 3)
         if ai and peak and hbm:
             ridge = peak / hbm
             # roofline ceiling: HBM-bound below the ridge point
