@@ -1,0 +1,250 @@
+"""Import trained PyTorch weights from the reference's model families.
+
+The reference (`/root/reference/src/pytorch/{MLP,CNN,LSTM}/model.py`) is
+torch; a user switching to this framework brings `state_dict()` files.
+These importers convert them into this package's Flax variables with
+exact forward-pass parity (tested against torch twins in
+`tests/test_torch_migrate.py`):
+
+* layout: torch `Linear` stores `(out, in)` -> Flax kernel `(in, out)`;
+  `Conv1d` `(O, I, K)` -> `(K, I, O)`; `Conv2d` `(O, I, H, W)` ->
+  NHWC-native `(H, W, I, O)`.
+* BatchNorm: `weight/bias` -> `scale/bias` params; `running_mean/var` ->
+  the `batch_stats` collection (`num_batches_tracked` is dropped); the
+  torch-vs-flax momentum-complement is a MODEL concern, already handled
+  at `models/densenet.py:44` — stats import unchanged.
+* LSTM: torch packs the four gates row-wise as (i, f, g, o) in
+  `weight_ih_l{k}`/`weight_hh_l{k}`; Flax `OptimizedLSTMCell` keeps
+  per-gate kernels (`ii/if/ig/io`, `hi/hf/hg/ho`) and a SINGLE bias per
+  gate on the hidden branch — torch's two biases sum into it.
+
+Matching is POSITIONAL BY TYPE: `state_dict()` preserves registration
+order, which for the reference models (plain sequential construction) is
+forward order — so importers consume typed parameter groups in order
+instead of depending on the reference's attribute names.  Every import
+is validated leaf-by-leaf (structure + shapes) against `model.init`.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+__all__ = ["mlp_params_from_torch", "cnn_lstm_params_from_torch",
+           "densenet_params_from_torch"]
+
+
+def _to_np(t) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor, without importing torch
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _typed_groups(state_dict) -> list[tuple[str, dict]]:
+    """Insertion-ordered (kind, tensors) groups from a torch state_dict.
+
+    Kinds: ``linear`` (2-D weight [+bias]), ``conv1d``/``conv2d``,
+    ``bn`` (weight/bias/running_mean/running_var), ``lstm`` (one group
+    PER stacked layer: weight_ih/weight_hh/bias_ih/bias_hh).
+
+    ALIASED registrations are dropped: a module registered under two
+    names (the reference's ``WrapperTriton`` does ``self.layer = ...``
+    then ``add_module('DenseLayer', self.layer)``, `CNN/model.py:72`)
+    appears twice in ``state_dict()`` with tensors sharing storage —
+    torch serialisation preserves the sharing, so the duplicate group's
+    data pointers match the first occurrence and it is skipped.
+    """
+    def _ptr(val) -> int:
+        if hasattr(val, "data_ptr"):      # torch tensor (incl. loaded)
+            return val.data_ptr()
+        return id(val)
+
+    order: list[str] = []
+    by_prefix: dict[str, dict] = {}
+    seen_ptrs: set[int] = set()
+    for key, val in state_dict.items():
+        prefix, _, leaf = key.rpartition(".")
+        if prefix not in by_prefix:
+            ptrs = {_ptr(v) for k, v in state_dict.items()
+                    if k.rpartition(".")[0] == prefix}
+            if ptrs <= seen_ptrs:
+                continue  # every tensor aliases an earlier registration
+            seen_ptrs |= ptrs
+            by_prefix[prefix] = {}
+            order.append(prefix)
+        if prefix in by_prefix:
+            by_prefix[prefix][leaf] = _to_np(val)
+
+    groups: list[tuple[str, dict]] = []
+    for prefix in order:
+        g = by_prefix[prefix]
+        if "running_mean" in g:
+            groups.append(("bn", g))
+        elif "weight_ih_l0" in g:
+            layer = 0
+            while f"weight_ih_l{layer}" in g:
+                groups.append(("lstm", {
+                    name: g[f"{name}_l{layer}"]
+                    for name in ("weight_ih", "weight_hh",
+                                 "bias_ih", "bias_hh")}))
+                layer += 1
+        elif g.get("weight") is not None and g["weight"].ndim == 2:
+            groups.append(("linear", g))
+        elif g.get("weight") is not None and g["weight"].ndim == 3:
+            groups.append(("conv1d", g))
+        elif g.get("weight") is not None and g["weight"].ndim == 4:
+            groups.append(("conv2d", g))
+        # anything else (e.g. a bare num_batches_tracked prefix) is ignored
+    return groups
+
+
+class _Consumer:
+    """Pop typed groups in order, failing loudly on a kind mismatch."""
+
+    def __init__(self, state_dict):
+        self._groups = _typed_groups(state_dict)
+        self._pos = 0
+
+    def take(self, kind: str) -> dict:
+        if self._pos >= len(self._groups):
+            raise ValueError(f"state_dict exhausted wanting a {kind!r} "
+                             f"group at position {self._pos}")
+        got, tensors = self._groups[self._pos]
+        if got != kind:
+            raise ValueError(f"state_dict group {self._pos} is {got!r}, "
+                             f"expected {kind!r} — is this checkpoint from "
+                             "the matching reference model family?")
+        self._pos += 1
+        return tensors
+
+    def finish(self) -> None:
+        if self._pos != len(self._groups):
+            raise ValueError(f"{len(self._groups) - self._pos} unconsumed "
+                             "parameter groups — model config (layers/"
+                             "blocks) smaller than the checkpoint's")
+
+
+def _linear(g: dict) -> dict:
+    out = {"kernel": g["weight"].T}
+    if "bias" in g:
+        out["bias"] = g["bias"]
+    return out
+
+
+def _conv2d(g: dict) -> dict:
+    out = {"kernel": g["weight"].transpose(2, 3, 1, 0)}  # OIHW -> HWIO
+    if "bias" in g:
+        out["bias"] = g["bias"]
+    return out
+
+
+def _bn(g: dict) -> tuple[dict, dict]:
+    return ({"scale": g["weight"], "bias": g["bias"]},
+            {"mean": g["running_mean"], "var": g["running_var"]})
+
+
+def _validated(model, example, variables: dict) -> dict:
+    """Leaf-by-leaf structure+shape check against ``model.init``; returns
+    the imported tree with each leaf cast to the init leaf's dtype."""
+    ref = model.init(jax.random.key(0), example)
+    ref_flat = jax.tree_util.tree_flatten_with_path(ref)
+    got_flat = jax.tree_util.tree_flatten_with_path(variables)
+    if ref_flat[1] != got_flat[1]:
+        ref_paths = {jax.tree_util.keystr(p) for p, _ in ref_flat[0]}
+        got_paths = {jax.tree_util.keystr(p) for p, _ in got_flat[0]}
+        raise ValueError(
+            "imported tree structure mismatch; "
+            f"missing={sorted(ref_paths - got_paths)} "
+            f"extra={sorted(got_paths - ref_paths)}")
+    leaves = []
+    for (path, r), (_, g) in zip(ref_flat[0], got_flat[0]):
+        if tuple(r.shape) != tuple(np.shape(g)):
+            raise ValueError(f"shape mismatch at {jax.tree_util.keystr(path)}"
+                             f": checkpoint {np.shape(g)} vs model {r.shape}")
+        leaves.append(np.asarray(g, dtype=r.dtype))
+    return jax.tree_util.tree_unflatten(ref_flat[1], leaves)
+
+
+# --------------------------------------------------------------------------
+# family importers
+# --------------------------------------------------------------------------
+
+def mlp_params_from_torch(state_dict, model, example) -> dict:
+    """Reference MLP (`MLP/model.py:23-76`): Linear stack -> `models.mlp.MLP`
+    variables (`{"params": ...}`)."""
+    c = _Consumer(state_dict)
+    params: dict[str, Any] = {}
+    for i in range(model.num_hidden_layers + 1):
+        params[f"DenseReLU_{i}"] = {"Dense_0": _linear(c.take("linear"))}
+    params["DenseHead_0"] = {"Dense_0": _linear(c.take("linear"))}
+    c.finish()
+    return _validated(model, example, {"params": params})
+
+
+def cnn_lstm_params_from_torch(state_dict, model, example) -> dict:
+    """Reference CNN-LSTM (`LSTM/model.py:38-96`): Conv1d stem + stacked
+    LSTM + head -> `models.cnn_lstm.CNNLSTM` variables."""
+    c = _Consumer(state_dict)
+    conv = c.take("conv1d")
+    params: dict[str, Any] = {"PdMConvStem_0": {"Conv_0": {
+        # torch Conv1d (O, I, K) -> flax (K, I, O)
+        "kernel": conv["weight"].transpose(2, 1, 0),
+        **({"bias": conv["bias"]} if "bias" in conv else {}),
+    }}}
+    for i in range(model.hidden_layers):
+        g = c.take("lstm")
+        hidden = g["weight_hh"].shape[1]
+        cell: dict[str, Any] = {}
+        for j, gate in enumerate(("i", "f", "g", "o")):
+            rows = slice(j * hidden, (j + 1) * hidden)
+            cell[f"i{gate}"] = {"kernel": g["weight_ih"][rows].T}
+            cell[f"h{gate}"] = {"kernel": g["weight_hh"][rows].T,
+                                # flax keeps ONE bias per gate (hidden
+                                # branch); torch's pair sums into it
+                                "bias": g["bias_ih"][rows] +
+                                        g["bias_hh"][rows]}
+        params[f"LSTMLayer_{i}"] = {"OptimizedLSTMCell_0": cell}
+    params["RegressionHead_0"] = {"Dense_0": _linear(c.take("linear"))}
+    c.finish()
+    return _validated(model, example, {"params": params})
+
+
+def densenet_params_from_torch(state_dict, model, example) -> dict:
+    """Reference DenseNet-BC (`CNN/model.py:104-193`): stem / dense blocks /
+    transitions / classifier -> `models.densenet.DenseNet` variables
+    (`{"params": ..., "batch_stats": ...}`)."""
+    c = _Consumer(state_dict)
+    params: dict[str, Any] = {}
+    stats: dict[str, Any] = {}
+
+    params["Stem_0"] = {"Conv_0": _conv2d(c.take("conv2d"))}
+    p, s = _bn(c.take("bn"))
+    params["StemNorm_0"] = {"BatchNorm_0": p}
+    stats["StemNorm_0"] = {"BatchNorm_0": s}
+
+    for b in range(model.dense_blocks):
+        block_p: dict[str, Any] = {}
+        block_s: dict[str, Any] = {}
+        for l in range(model.dense_layers):
+            p0, s0 = _bn(c.take("bn"))
+            conv0 = _conv2d(c.take("conv2d"))
+            p1, s1 = _bn(c.take("bn"))
+            conv1 = _conv2d(c.take("conv2d"))
+            block_p[f"DenseLayer_{l}"] = {"BatchNorm_0": p0, "Conv_0": conv0,
+                                          "BatchNorm_1": p1, "Conv_1": conv1}
+            block_s[f"DenseLayer_{l}"] = {"BatchNorm_0": s0,
+                                          "BatchNorm_1": s1}
+        params[f"DenseBlock_{b}"] = block_p
+        stats[f"DenseBlock_{b}"] = block_s
+        if b < model.dense_blocks - 1:
+            p, s = _bn(c.take("bn"))
+            params[f"Transition_{b}"] = {"BatchNorm_0": p,
+                                         "Conv_0": _conv2d(c.take("conv2d"))}
+            stats[f"Transition_{b}"] = {"BatchNorm_0": s}
+
+    params["Classifier_0"] = {"Dense_0": _linear(c.take("linear"))}
+    c.finish()
+    return _validated(model, example,
+                      {"params": params, "batch_stats": stats})
