@@ -214,11 +214,12 @@ class TransformerLayer(nn.Module):
     rope: bool = False
     window: Optional[int] = None
     num_kv_heads: Optional[int] = None
+    ln_eps: float = 1e-6   # 1e-5 matches torch/HF LayerNorm (GPT-2 import)
 
     @nn.compact
     def __call__(self, x, encoded=None, *, self_valid=None, cross_valid=None,
                  train: bool = False):
-        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.LayerNorm(dtype=self.dtype, epsilon=self.ln_eps)(x)
         h = MultiHeadAttention(self.num_heads, self.dtype, self.attention_fn,
                                decode=self.decode, rope=self.rope,
                                window=self.window,
@@ -228,13 +229,13 @@ class TransformerLayer(nn.Module):
         h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
         x = x + h
         if self.cross_attention:
-            h = nn.LayerNorm(dtype=self.dtype)(x)
+            h = nn.LayerNorm(dtype=self.dtype, epsilon=self.ln_eps)(x)
             h = MultiHeadAttention(self.num_heads, self.dtype,
                                    self.attention_fn,
                                    name="cross_attn")(h, encoded, cross_valid)
             h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
             x = x + h
-        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.LayerNorm(dtype=self.dtype, epsilon=self.ln_eps)(x)
         h = nn.Dense(self.mlp_dim, dtype=self.dtype, kernel_init=dense_init)(h)
         h = nn.gelu(h)
         h = nn.Dense(x.shape[-1], dtype=self.dtype, kernel_init=dense_init)(h)
@@ -365,10 +366,12 @@ class CausalLM(nn.Module):
     num_kv_heads: Optional[int] = None      # grouped-query attention
     dtype: jnp.dtype = jnp.float32
     attention_fn: Optional[AttentionFn] = None
+    ln_eps: float = 1e-6   # 1e-5 matches torch/HF LayerNorm (GPT-2 import)
+    pad_id: Optional[int] = 0   # None: no padding id (GPT-2's id 0 is "!")
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
-        valid = tokens != 0
+        valid = tokens != self.pad_id if self.pad_id is not None else None
         rope = self.pos_embedding == "rope"
         x, emb = Embed(self.vocab_size, self.d_model, max_len=self.max_len,
                        dtype=self.dtype, decode=self.decode,
@@ -381,9 +384,11 @@ class CausalLM(nn.Module):
                                  decode=self.decode, rope=rope,
                                  window=self.attention_window,
                                  num_kv_heads=self.num_kv_heads,
+                                 ln_eps=self.ln_eps,
                                  name=f"layer_{i}")(x, self_valid=valid,
                                                     train=train)
-        x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
+        x = nn.LayerNorm(dtype=self.dtype, epsilon=self.ln_eps,
+                         name="final_norm")(x)
         # the CLI/workload convention wants logits (token_cross_entropy +
         # argmax metrics); the bench path keeps hidden states and the
         # fused head (loss()) so (B·T, V) never materialises
@@ -419,6 +424,7 @@ class BertEncoder(nn.Module):
     dropout_rate: float = 0.1
     dtype: jnp.dtype = jnp.float32
     attention_fn: Optional[AttentionFn] = None
+    ln_eps: float = 1e-6   # HF BERT checkpoints use 1e-12
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -429,13 +435,16 @@ class BertEncoder(nn.Module):
             x = TransformerLayer(self.num_heads, self.mlp_dim,
                                  self.dropout_rate, dtype=self.dtype,
                                  attention_fn=self.attention_fn,
+                                 ln_eps=self.ln_eps,
                                  name=f"layer_{i}")(x, self_valid=valid,
                                                     train=train)
-        x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
+        x = nn.LayerNorm(dtype=self.dtype, epsilon=self.ln_eps,
+                         name="final_norm")(x)
         # MLM head: dense + gelu + norm, weight-tied vocab projection
         h = nn.Dense(self.d_model, dtype=self.dtype, name="mlm_dense")(x)
         h = nn.gelu(h)
-        h = nn.LayerNorm(dtype=self.dtype, name="mlm_norm")(h)
+        h = nn.LayerNorm(dtype=self.dtype, epsilon=self.ln_eps,
+                         name="mlm_norm")(h)
         return Embed.logits(h, emb)
 
 

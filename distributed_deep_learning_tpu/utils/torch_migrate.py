@@ -33,7 +33,7 @@ import jax
 import numpy as np
 
 __all__ = ["mlp_params_from_torch", "cnn_lstm_params_from_torch",
-           "densenet_params_from_torch"]
+           "densenet_params_from_torch", "causal_lm_params_from_hf_gpt2"]
 
 
 def _to_np(t) -> np.ndarray:
@@ -61,21 +61,23 @@ def _typed_groups(state_dict) -> list[tuple[str, dict]]:
             return val.data_ptr()
         return id(val)
 
+    # single pass: prefix -> leaves and pointer sets (insertion-ordered)
+    raw: dict[str, dict] = {}
+    ptrs: dict[str, set[int]] = {}
+    for key, val in state_dict.items():
+        prefix, _, leaf = key.rpartition(".")
+        raw.setdefault(prefix, {})[leaf] = val
+        ptrs.setdefault(prefix, set()).add(_ptr(val))
+
     order: list[str] = []
     by_prefix: dict[str, dict] = {}
     seen_ptrs: set[int] = set()
-    for key, val in state_dict.items():
-        prefix, _, leaf = key.rpartition(".")
-        if prefix not in by_prefix:
-            ptrs = {_ptr(v) for k, v in state_dict.items()
-                    if k.rpartition(".")[0] == prefix}
-            if ptrs <= seen_ptrs:
-                continue  # every tensor aliases an earlier registration
-            seen_ptrs |= ptrs
-            by_prefix[prefix] = {}
-            order.append(prefix)
-        if prefix in by_prefix:
-            by_prefix[prefix][leaf] = _to_np(val)
+    for prefix, leaves in raw.items():
+        if ptrs[prefix] <= seen_ptrs:
+            continue  # every tensor aliases an earlier registration
+        seen_ptrs |= ptrs[prefix]
+        by_prefix[prefix] = {k: _to_np(v) for k, v in leaves.items()}
+        order.append(prefix)
 
     groups: list[tuple[str, dict]] = []
     for prefix in order:
@@ -83,13 +85,22 @@ def _typed_groups(state_dict) -> list[tuple[str, dict]]:
         if "running_mean" in g:
             groups.append(("bn", g))
         elif "weight_ih_l0" in g:
+            consumed = set()
             layer = 0
             while f"weight_ih_l{layer}" in g:
-                groups.append(("lstm", {
-                    name: g[f"{name}_l{layer}"]
-                    for name in ("weight_ih", "weight_hh",
-                                 "bias_ih", "bias_hh")}))
+                names = [f"{n}_l{layer}" for n in
+                         ("weight_ih", "weight_hh", "bias_ih", "bias_hh")]
+                groups.append(("lstm", dict(zip(
+                    ("weight_ih", "weight_hh", "bias_ih", "bias_hh"),
+                    (g[n] for n in names)))))
+                consumed.update(names)
                 layer += 1
+            extra = set(g) - consumed
+            if extra:  # _reverse (bidirectional) / _hr (proj_size) leaves
+                raise ValueError(
+                    f"LSTM group has unsupported leaves {sorted(extra)} "
+                    "(bidirectional/proj_size checkpoints have no "
+                    "equivalent in this package's LSTM)")
         elif g.get("weight") is not None and g["weight"].ndim == 2:
             groups.append(("linear", g))
         elif g.get("weight") is not None and g["weight"].ndim == 3:
@@ -208,6 +219,87 @@ def cnn_lstm_params_from_torch(state_dict, model, example) -> dict:
         params[f"LSTMLayer_{i}"] = {"OptimizedLSTMCell_0": cell}
     params["RegressionHead_0"] = {"Dense_0": _linear(c.take("linear"))}
     c.finish()
+    return _validated(model, example, {"params": params})
+
+
+def causal_lm_params_from_hf_gpt2(state_dict, model, example) -> dict:
+    """HuggingFace GPT-2 weights -> `models.transformer.CausalLM`.
+
+    Beyond-reference interop: the architectures align exactly (pre-LN
+    blocks, tanh-approximate gelu, learned positions, weight-tied head),
+    so pretrained GPT-2 checkpoints load into the TPU-native LM.  Build
+    the target as ``CausalLM(vocab_size=50257, num_layers=12,
+    d_model=768, num_heads=12, mlp_dim=3072, max_len=1024,
+    ln_eps=1e-5, pad_id=None)`` for gpt2-small — ``ln_eps=1e-5``
+    matches HF's LayerNorm epsilon and ``pad_id=None`` disables this
+    package's id-0-is-padding convention (GPT-2's id 0 is the real
+    token ``"!"``), making the import numerically exact (tested to
+    2e-5 logits parity, including id-0 tokens).  Mapping is NAME-based (HF's key names are a stable
+    public contract, unlike the reference's): ``wte/wpe`` -> the embed
+    table/positions, packed ``c_attn`` (d, 3d) splits into per-head
+    q/k/v DenseGeneral kernels (HF's head split is H-major like Flax's,
+    and Conv1D already stores (in, out) — no transposes anywhere),
+    ``c_proj`` reshapes to the (H, Dh, d) out kernel, ``ln_1/ln_2/ln_f``
+    -> the pre-LNs and final norm.  ``lm_head.weight`` (tied) and the
+    causal-mask buffers are ignored; any other leftover key is an error.
+    """
+    sd = {}
+    for key, val in state_dict.items():
+        key = key.removeprefix("transformer.")
+        if key == "lm_head.weight" or key.endswith(
+                (".attn.bias", ".attn.masked_bias")):
+            continue  # tied duplicate / causal-mask buffers
+        sd[key] = _to_np(val)
+
+    d, H = model.d_model, model.num_heads
+    dh = d // H
+    used = set()
+
+    def take(key: str) -> np.ndarray:
+        if key not in sd:
+            raise ValueError(f"GPT-2 key {key!r} missing from the "
+                             "checkpoint — model config (num_layers?) "
+                             "larger than the checkpoint's")
+        used.add(key)
+        return sd[key]
+
+    def ln(prefix: str) -> dict:
+        return {"scale": take(f"{prefix}.weight"),
+                "bias": take(f"{prefix}.bias")}
+
+    params: dict[str, Any] = {
+        "embed": {"tok": {"embedding": take("wte.weight")},
+                  "pos": take("wpe.weight")},
+        "final_norm": ln("ln_f"),
+    }
+    for i in range(model.num_layers):
+        pre = f"h.{i}"
+        qw, kw, vw = np.split(take(f"{pre}.attn.c_attn.weight"), 3, axis=1)
+        qb, kb, vb = np.split(take(f"{pre}.attn.c_attn.bias"), 3)
+        params[f"layer_{i}"] = {
+            "LayerNorm_0": ln(f"{pre}.ln_1"),
+            "self_attn": {
+                "q": {"kernel": qw.reshape(d, H, dh),
+                      "bias": qb.reshape(H, dh)},
+                "k": {"kernel": kw.reshape(d, H, dh),
+                      "bias": kb.reshape(H, dh)},
+                "v": {"kernel": vw.reshape(d, H, dh),
+                      "bias": vb.reshape(H, dh)},
+                "out": {"kernel":
+                        take(f"{pre}.attn.c_proj.weight").reshape(H, dh, d),
+                        "bias": take(f"{pre}.attn.c_proj.bias")},
+            },
+            "LayerNorm_1": ln(f"{pre}.ln_2"),
+            "Dense_0": {"kernel": take(f"{pre}.mlp.c_fc.weight"),
+                        "bias": take(f"{pre}.mlp.c_fc.bias")},
+            "Dense_1": {"kernel": take(f"{pre}.mlp.c_proj.weight"),
+                        "bias": take(f"{pre}.mlp.c_proj.bias")},
+        }
+    leftover = set(sd) - used
+    if leftover:
+        raise ValueError(f"unconsumed GPT-2 keys {sorted(leftover)[:5]}... — "
+                         "model config (num_layers?) smaller than the "
+                         "checkpoint's")
     return _validated(model, example, {"params": params})
 
 

@@ -205,3 +205,86 @@ def test_size_mismatch_rejected():
         # model expects 1 hidden layer; checkpoint carries 2
         mlp_params_from_torch(tm.state_dict(), MLP(num_hidden_layers=1),
                               np.zeros((1, 48), np.float32))
+
+
+def test_gpt2_import_logits_parity():
+    """HF GPT-2 (random init, built offline from config) -> CausalLM:
+    logits parity proves the full mapping — packed qkv split, head
+    ordering, Conv1D orientation, tied head, final norm."""
+    transformers = pytest.importorskip("transformers")
+
+    from distributed_deep_learning_tpu.models.transformer import CausalLM
+
+    cfg = transformers.GPT2Config(
+        vocab_size=97, n_positions=32, n_embd=48, n_layer=2, n_head=4,
+        attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0)
+    hf = transformers.GPT2LMHeadModel(cfg).eval()
+
+    # include id 0 on purpose: GPT-2's id 0 is a real token, and the
+    # import recipe disables this package's id-0-is-padding convention
+    toks = np.random.default_rng(4).integers(0, 97, (2, 16))
+    toks[0, 3] = 0
+    with torch.no_grad():
+        want = hf(torch.from_numpy(toks)).logits.numpy()
+
+    model = CausalLM(vocab_size=97, num_layers=2, d_model=48, num_heads=4,
+                     mlp_dim=4 * 48, max_len=32, with_logits=True,
+                     ln_eps=1e-5, pad_id=None)  # HF eps; id 0 is a token
+    from distributed_deep_learning_tpu.utils.torch_migrate import (
+        causal_lm_params_from_hf_gpt2)
+
+    variables = causal_lm_params_from_hf_gpt2(
+        hf.state_dict(), model, jnp.asarray(toks[:1, :4], jnp.int32))
+    got = model.apply(variables, jnp.asarray(toks, jnp.int32))
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_gpt2_import_rejects_layer_mismatch():
+    transformers = pytest.importorskip("transformers")
+
+    from distributed_deep_learning_tpu.models.transformer import CausalLM
+    from distributed_deep_learning_tpu.utils.torch_migrate import (
+        causal_lm_params_from_hf_gpt2)
+
+    cfg = transformers.GPT2Config(vocab_size=97, n_positions=32, n_embd=48,
+                                  n_layer=3, n_head=4)
+    hf = transformers.GPT2LMHeadModel(cfg)
+    model = CausalLM(vocab_size=97, num_layers=2, d_model=48, num_heads=4,
+                     mlp_dim=192, max_len=32, with_logits=True)
+    with pytest.raises(ValueError, match="unconsumed GPT-2 keys"):
+        causal_lm_params_from_hf_gpt2(
+            hf.state_dict(), model, jnp.ones((1, 4), jnp.int32))
+
+
+def test_bidirectional_lstm_rejected():
+    from distributed_deep_learning_tpu.models.cnn_lstm import CNNLSTM
+
+    class Twin(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv = torch.nn.Conv1d(10, 64, 1)
+            self.lstm = torch.nn.LSTM(32, 64, bidirectional=True,
+                                      batch_first=True)
+            self.head = torch.nn.Linear(128, 5)
+
+    with pytest.raises(ValueError, match="unsupported leaves"):
+        cnn_lstm_params_from_torch(
+            Twin().state_dict(), CNNLSTM(hidden_size=64),
+            np.zeros((1, 10, 32), np.float32))
+
+
+def test_gpt2_rejects_model_larger_than_checkpoint():
+    transformers = pytest.importorskip("transformers")
+
+    from distributed_deep_learning_tpu.models.transformer import CausalLM
+    from distributed_deep_learning_tpu.utils.torch_migrate import (
+        causal_lm_params_from_hf_gpt2)
+
+    cfg = transformers.GPT2Config(vocab_size=97, n_positions=32, n_embd=48,
+                                  n_layer=1, n_head=4)
+    hf = transformers.GPT2LMHeadModel(cfg)
+    model = CausalLM(vocab_size=97, num_layers=2, d_model=48, num_heads=4,
+                     mlp_dim=192, max_len=32, with_logits=True)
+    with pytest.raises(ValueError, match="missing from the checkpoint"):
+        causal_lm_params_from_hf_gpt2(
+            hf.state_dict(), model, jnp.ones((1, 4), jnp.int32))
