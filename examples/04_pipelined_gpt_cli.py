@@ -17,7 +17,6 @@ Equivalent shell command (on a real multi-chip host):
         -m pipeline --nstages 4 --pipeline-schedule 1f1b
 """
 
-import json
 import os
 import runpy
 import sys
@@ -32,9 +31,7 @@ sys.argv = ["ddl", "gpt", "-l", "4", "-s", "64", "-e", "2", "-b", "16",
             "--pipeline-schedule", "1f1b", "--metrics-file", metrics]
 runpy.run_module("distributed_deep_learning_tpu", run_name="__main__")
 
-trains = [json.loads(l) for l in open(metrics)
-          if json.loads(l).get("phase") == "train"
-          and json.loads(l)["event"] == "phase_end"]
+trains = _bootstrap.train_phase_ends(metrics)
 assert trains[-1]["loss"] < trains[0]["loss"], "pipeline run did not learn"
 print(f"pipelined train loss: {trains[0]['loss']:.4f} -> "
       f"{trains[-1]['loss']:.4f}")

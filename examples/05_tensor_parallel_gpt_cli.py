@@ -15,7 +15,6 @@ Equivalent shell command (8 devices):
         -m data --mesh data=4,model=2
 """
 
-import json
 import os
 import runpy
 import sys
@@ -37,9 +36,7 @@ sys.argv = ["ddl", "gpt", "-l", "2", "-s", "64", "-e", "2", "-b", "16",
             "-m", "data", "--mesh", mesh, "--metrics-file", metrics]
 runpy.run_module("distributed_deep_learning_tpu", run_name="__main__")
 
-trains = [json.loads(l) for l in open(metrics)
-          if json.loads(l).get("phase") == "train"
-          and json.loads(l)["event"] == "phase_end"]
+trains = _bootstrap.train_phase_ends(metrics)
 assert trains[-1]["loss"] < trains[0]["loss"], "TP run did not learn"
 print(f"tensor-parallel ({mesh}) train loss: {trains[0]['loss']:.4f} -> "
       f"{trains[-1]['loss']:.4f}")

@@ -24,3 +24,13 @@ import jax  # noqa: E402  (env above must precede the first jax import)
 
 if not USE_TPU:
     jax.config.update("jax_platforms", "cpu")
+
+
+def train_phase_ends(metrics_path):
+    """Parse the --metrics-file JSONL once and return the train-phase
+    `phase_end` events in order (shared by the CLI examples' asserts)."""
+    import json
+
+    events = [json.loads(line) for line in open(metrics_path)]
+    return [e for e in events
+            if e["event"] == "phase_end" and e.get("phase") == "train"]
