@@ -458,16 +458,18 @@ def bert_base(**kw) -> BertEncoder:
 
 def generate(model: "CausalLM", params, prompt: jnp.ndarray, *,
              max_new_tokens: int, temperature: float = 0.0,
-             top_k: int | None = None,
+             top_k: int | None = None, top_p: float | None = None,
              rng: jnp.ndarray | None = None) -> jnp.ndarray:
     """KV-cached autoregressive generation from a trained :class:`CausalLM`.
 
     ``prompt`` is (B, P) token ids; returns the (B, max_new_tokens)
     continuation.  Greedy at ``temperature == 0.0``, else samples from
-    ``softmax(logits / temperature)``.  The whole loop is one ``lax.scan``
-    of 1-token cached decode steps (O(T) per token via the attention KV
-    cache; positions follow the cache index) — jit-compatible, static
-    shapes, TPU-friendly.
+    ``softmax(logits / temperature)``, optionally truncated to the top-k
+    logits and/or the top-p (nucleus) mass — both filters compose, k
+    first then p, as in the common HF semantics.  The whole loop is one
+    ``lax.scan`` of 1-token cached decode steps (O(T) per token via the
+    attention KV cache; positions follow the cache index) —
+    jit-compatible, static shapes, TPU-friendly.
 
     The reference has no inference story at all (SURVEY.md: every run is
     train-then-test); this is part of the LM-family surface a complete
@@ -501,21 +503,37 @@ def generate(model: "CausalLM", params, prompt: jnp.ndarray, *,
 
     if top_k is not None and top_k < 1:
         raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
 
     def pick(hidden_last, key):
         nl = model.logits_from({"params": params}, hidden_last)  # (B, V)
-        # never emit pad id 0: the cache records a generated 0 as invalid
-        # (valid = tokens != 0), silently dropping that position from all
-        # subsequent attention and skewing the continuation (ADVICE r3)
-        nl = nl.at[:, 0].set(-jnp.inf)
+        if model.pad_id is not None:
+            # never emit the pad id: the cache records a generated pad as
+            # invalid (valid = tokens != pad_id), silently dropping that
+            # position from all subsequent attention and skewing the
+            # continuation (ADVICE r3).  pad_id=None (e.g. imported
+            # GPT-2, whose id 0 is a real token) has no such hazard.
+            nl = nl.at[:, model.pad_id].set(-jnp.inf)
         if top_k is not None and top_k < nl.shape[-1]:
             # mask everything below the k-th logit (static k — jit-safe)
             kth = jnp.sort(nl, axis=-1)[:, -top_k][:, None]
             nl = jnp.where(nl >= kth, nl, -jnp.inf)
         if temperature == 0.0:
             return jnp.argmax(nl, axis=-1), key
+        scaled = nl / temperature
+        if top_p is not None and top_p < 1.0:
+            # nucleus: keep the smallest prefix of the sorted distribution
+            # whose mass reaches top_p (the crossing token included)
+            order = jnp.argsort(-scaled, axis=-1)
+            sp = jnp.take_along_axis(jax.nn.softmax(scaled, axis=-1),
+                                     order, axis=-1)
+            drop_sorted = jnp.cumsum(sp, axis=-1) - sp > top_p
+            drop = jnp.zeros_like(drop_sorted).at[
+                jnp.arange(nl.shape[0])[:, None], order].set(drop_sorted)
+            scaled = jnp.where(drop, -jnp.inf, scaled)
         key, sub = jax.random.split(key)
-        return jax.random.categorical(sub, nl / temperature), key
+        return jax.random.categorical(sub, scaled), key
 
     # prefill: the whole prompt in ONE multi-token cached call (the
     # decode-mode causal prefix mask keeps in-chunk attention causal)

@@ -253,6 +253,49 @@ def test_top_k_sampling():
         generate(model, params, prompt, max_new_tokens=2, top_k=0)
 
 
+def test_top_p_sampling():
+    """Tiny top_p reproduces greedy (only the max token survives the
+    nucleus); top_p composes with temperature; bounds are validated."""
+    import pytest
+
+    model = _model(with_logits=True)
+    prompt = jax.random.randint(jax.random.key(40), (2, 4), 1, 61)
+    params = model.init(jax.random.key(41), prompt)["params"]
+
+    greedy = generate(model, params, prompt, max_new_tokens=5)
+    nucleus = generate(model, params, prompt, max_new_tokens=5,
+                       temperature=1.0, top_p=1e-9,
+                       rng=jax.random.key(42))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(nucleus))
+
+    out = generate(model, params, prompt, max_new_tokens=5,
+                   temperature=1.5, top_p=0.9, rng=jax.random.key(43))
+    assert out.shape == (2, 5)
+    # top_p=1.0 is a no-op relative to plain temperature sampling
+    plain = generate(model, params, prompt, max_new_tokens=5,
+                     temperature=1.5, rng=jax.random.key(43))
+    full = generate(model, params, prompt, max_new_tokens=5,
+                    temperature=1.5, top_p=1.0, rng=jax.random.key(43))
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(full))
+
+    for bad in (0.0, -0.5, 1.5):
+        with pytest.raises(ValueError, match="top_p"):
+            generate(model, params, prompt, max_new_tokens=2,
+                     temperature=1.0, top_p=bad)
+
+
+def test_generate_pad_free_model_can_emit_id_zero():
+    """pad_id=None (imported GPT-2: id 0 is a real token) removes the
+    never-emit-0 mask — id 0 must be sampleable again."""
+    model = _model(with_logits=True).clone(pad_id=None)
+    prompt = jax.random.randint(jax.random.key(50), (8, 4), 1, 61)
+    params = model.init(jax.random.key(51), prompt)["params"]
+    out = generate(model, params, prompt, max_new_tokens=24,
+                   temperature=50.0, rng=jax.random.key(52))
+    # near-uniform sampling over 61 ids x 192 draws: id 0 shows up
+    assert (np.asarray(out) == 0).any()
+
+
 def test_generate_never_emits_pad_id():
     """ADVICE r3: a generated 0 would be recorded invalid in the KV cache
     (valid = tokens != 0) and silently vanish from later attention — so
