@@ -65,18 +65,22 @@ _REDUCERS = {"bf16": _psum_bf16, "int8": _psum_int8}
 
 def make_compressed_step_fns(mesh: Mesh, loss_fn: Callable, *,
                              method: str = "bf16", remat: bool = False,
+                             remat_policy: str = "nothing",
                              batch_spec: P = P(BATCH_AXES)):
     """(train_step, eval_step) with a compressed gradient all-reduce.
 
     Data-parallel only (params/optimizer replicated): compressing a
     reduction only makes sense when there IS a pure gradient all-reduce;
     ZeRO/TP reshape the dataflow instead — the runner rejects those
-    combinations.  ``remat`` rematerialises the forward in backward exactly
-    like :func:`.step.make_step_fns`.
+    combinations.  ``remat``/``remat_policy`` rematerialise the forward
+    in backward exactly like :func:`.step.make_step_fns`.
     """
     if method not in _REDUCERS:
         raise ValueError(f"unknown compression {method!r}; "
                          f"choose from {sorted(_REDUCERS)}")
+    from distributed_deep_learning_tpu.train.step import _remat_policy
+
+    policy = _remat_policy(remat_policy)  # eager: fail fast on typos
     reduce_leaf = _REDUCERS[method]
     axes = tuple(a for a in BATCH_AXES if mesh.shape.get(a, 1) > 1)
     repl = NamedSharding(mesh, P())
@@ -98,7 +102,7 @@ def make_compressed_step_fns(mesh: Mesh, loss_fn: Callable, *,
             fwd = state.apply_fn
             if remat:
                 fwd = jax.checkpoint(lambda p, m, xx: state.apply_fn(
-                    p, m, xx, train=True, rngs=rngs))
+                    p, m, xx, train=True, rngs=rngs), policy=policy)
                 pred, new_ms, aux = fwd(params, ms, x)
             else:
                 pred, new_ms, aux = fwd(params, ms, x, train=True, rngs=rngs)

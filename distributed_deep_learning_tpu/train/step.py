@@ -36,9 +36,30 @@ def _state_sharding(mesh: Mesh, state_spec):
     return jax.tree.map(lambda s: NamedSharding(mesh, s), state_spec)
 
 
+REMAT_POLICIES = {
+    # what the backward may REUSE without recomputing (jax.checkpoint
+    # `policy=`); "nothing" is classic full rematerialisation
+    "nothing": None,   # jax.checkpoint's default: recompute everything
+    "dots": "dots_saveable",
+    # the usual TPU sweet spot: keep matmul outputs whose operands have
+    # no batch dims (weights-side dots) — saves the expensive MXU work,
+    # recomputes the cheap elementwise chains
+    "dots_no_batch": "dots_with_no_batch_dims_saveable",
+}
+
+
+def _remat_policy(name: str):
+    try:
+        attr = REMAT_POLICIES[name]
+    except KeyError:
+        raise ValueError(f"unknown remat policy {name!r}; choose from "
+                         f"{sorted(REMAT_POLICIES)}") from None
+    return getattr(jax.checkpoint_policies, attr) if attr else None
+
+
 def make_step_fns(mesh: Mesh, loss_fn: LossFn, *,
                   state_spec=P(), batch_spec=P(BATCH_AXES),
-                  remat: bool = False):
+                  remat: bool = False, remat_policy: str = "nothing"):
     """Build (train_step, eval_step), jitted with explicit shardings.
 
     ``state_spec`` defaults to fully-replicated parameters/optimizer state
@@ -50,9 +71,14 @@ def make_step_fns(mesh: Mesh, loss_fn: LossFn, *,
     ``remat=True`` wraps the forward in ``jax.checkpoint``: backward
     recomputes activations instead of storing them — the HBM-for-FLOPs
     trade that lets batch/model sizes exceed activation memory.  Numerics
-    are unchanged.
+    are unchanged.  ``remat_policy`` picks what the backward may keep
+    (:data:`REMAT_POLICIES`): ``"nothing"`` recomputes everything;
+    ``"dots"``/``"dots_no_batch"`` save matmul outputs so only the cheap
+    elementwise chains recompute — usually the better MFU trade on TPU,
+    where the recomputed FLOPs would otherwise hit the MXU twice.
     """
-    state_sh = _state_sharding(mesh, state_spec)
+    policy = _remat_policy(remat_policy)  # eager: fail fast on typos,
+    state_sh = _state_sharding(mesh, state_spec)  # even when remat=False
     batch_sh = NamedSharding(mesh, batch_spec)
     repl = NamedSharding(mesh, P())
 
@@ -66,7 +92,8 @@ def make_step_fns(mesh: Mesh, loss_fn: LossFn, *,
             if remat:
                 fwd = jax.checkpoint(
                     lambda p, ms, xx: state.apply_fn(p, ms, xx, train=True,
-                                                     rngs=rngs))
+                                                     rngs=rngs),
+                    policy=policy)
                 pred, new_ms, aux = fwd(params, state.model_state, x)
             else:
                 pred, new_ms, aux = fwd(params, state.model_state, x,

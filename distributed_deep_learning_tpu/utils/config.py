@@ -139,6 +139,7 @@ class Config:
     grad_accum: int = 1                 # gradient-accumulation microsteps
     dropout: float = 0.0                # train-time dropout rate (north-star models)
     remat: bool = False                 # rematerialise activations in backward
+    remat_policy: str = "nothing"       # what backward may keep (train/step.py)
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0           # also save every N train steps (0 = epoch-only)
     resume: bool = False
@@ -237,6 +238,11 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
     p.add_argument("--remat", action="store_true",
                    help="recompute activations in backward (jax.checkpoint) "
                         "— trades FLOPs for HBM")
+    p.add_argument("--remat-policy", dest="remat_policy", default="nothing",
+                   choices=["nothing", "dots", "dots_no_batch"],
+                   help="with --remat: what backward may reuse — 'nothing' "
+                        "recomputes all; 'dots'/'dots_no_batch' keep matmul "
+                        "outputs so only elementwise chains recompute")
     p.add_argument("--dropout", type=float, default=0.0,
                    help="dropout rate for transformer/bert workloads "
                         "(seeded per-step PRNG streams; 0 = deterministic)")
@@ -395,6 +401,7 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         grad_accum=args.grad_accum,
         dropout=args.dropout,
         remat=args.remat,
+        remat_policy=args.remat_policy,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,

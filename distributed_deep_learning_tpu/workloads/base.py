@@ -499,8 +499,14 @@ def _run_spmd_pipelined(spec: WorkloadSpec, config: Config, devices, logger,
     state = place_state(state, mesh, state_spec)
     train_step, eval_step = make_step_fns(mesh, loss_fn,
                                           state_spec=state_spec,
-                                          remat=config.remat)
+                                          remat=config.remat,
+                                          remat_policy=config.remat_policy)
     if config.pipeline_schedule in ("1f1b", "interleaved"):
+        if config.remat_policy != "nothing":
+            # the hand-scheduled pipeline backward hard-codes its own
+            # block remat; a policy here would be a silent no-op
+            raise ValueError("--remat-policy has no effect under "
+                             "--pipeline-schedule 1f1b/interleaved")
         # hand-scheduled backward: O(stages) activation residency instead
         # of the scan-transpose's O(microbatches); interleaved additionally
         # fills the bubble with --virtual-stages chunks per device
@@ -693,8 +699,13 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
 
             train_step, eval_step = make_compressed_step_fns(
                 mesh, loss_fn, method=config.grad_compress,
-                remat=config.remat)
+                remat=config.remat, remat_policy=config.remat_policy)
         elif config.grad_accum > 1:
+            if config.remat:
+                # rejected, not silently dropped (round-1 advisor
+                # principle): the accumulation scan has no remat wiring
+                raise ValueError("--remat with --grad-accum is not "
+                                 "implemented; drop one of the two")
             from distributed_deep_learning_tpu.train.accumulate import (
                 make_accum_step_fns)
 
@@ -702,9 +713,9 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
                 mesh, loss_fn, accum_steps=config.grad_accum,
                 state_spec=state_spec)
         else:
-            train_step, eval_step = make_step_fns(mesh, loss_fn,
-                                                  state_spec=state_spec,
-                                                  remat=config.remat)
+            train_step, eval_step = make_step_fns(
+                mesh, loss_fn, state_spec=state_spec, remat=config.remat,
+                remat_policy=config.remat_policy)
         ckpt, ckpt_step, start_epoch, resume_batch, resume_totals = \
             _maybe_checkpointer(config)
         if config.elastic:

@@ -95,3 +95,63 @@ def test_remat_matches_plain_step(mesh8):
         s_remat.params)
     np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
                                rtol=1e-6)
+
+
+def test_remat_policies_match_plain_step(mesh8):
+    """Selective remat (--remat-policy dots/dots_no_batch) keeps matmul
+    outputs instead of recomputing everything — numerics unchanged."""
+    batches = _batches(mesh8, n=2)
+    plain_step, _ = make_step_fns(mesh8, cross_entropy_loss)
+    s_plain = _fresh_state(mesh8)
+    for x, y in batches:
+        s_plain, m1 = plain_step(s_plain, x, y)
+    for policy in ("dots", "dots_no_batch"):
+        step, _ = make_step_fns(mesh8, cross_entropy_loss, remat=True,
+                                remat_policy=policy)
+        s = _fresh_state(mesh8)
+        for x, y in batches:
+            s, m2 = step(s, x, y)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6), s_plain.params,
+            s.params)
+        np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                                   rtol=1e-6)
+
+
+def test_remat_policy_validated(mesh8):
+    """Typos fail fast at construction, even with remat=False."""
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown remat policy"):
+        make_step_fns(mesh8, cross_entropy_loss, remat=True,
+                      remat_policy="bogus")
+    with pytest.raises(ValueError, match="unknown remat policy"):
+        make_step_fns(mesh8, cross_entropy_loss, remat_policy="dots_saveble")
+
+
+def test_cli_remat_policy(monkeypatch):
+    from distributed_deep_learning_tpu.utils.config import parse_args
+    from distributed_deep_learning_tpu.workloads import get_spec, run_workload
+
+    monkeypatch.setenv("DDL_DATA_LIMIT", "256")
+    argv = ["-e", "1", "-b", "64", "-m", "data", "--remat",
+            "--remat-policy", "dots_no_batch"]
+    c = parse_args(argv, workload="mlp")
+    assert c.remat and c.remat_policy == "dots_no_batch"
+    _, history = run_workload(get_spec("mlp"), c)
+    assert np.isfinite(history[-1].loss)
+
+
+def test_remat_with_grad_accum_rejected(monkeypatch):
+    """--remat + --grad-accum has no implementation: rejected, not
+    silently dropped."""
+    import pytest
+
+    from distributed_deep_learning_tpu.utils.config import parse_args
+    from distributed_deep_learning_tpu.workloads import get_spec, run_workload
+
+    monkeypatch.setenv("DDL_DATA_LIMIT", "256")
+    argv = ["-e", "1", "-b", "64", "-m", "data", "--remat",
+            "--grad-accum", "2"]
+    with pytest.raises(ValueError, match="--remat with --grad-accum"):
+        run_workload(get_spec("mlp"), parse_args(argv, workload="mlp"))

@@ -161,3 +161,22 @@ def test_compressed_dropout_per_shard_keys(mesh8):
     for _ in range(3):
         state, m = step(state, tokens, targets)
         assert np.isfinite(float(m["loss"]))
+
+
+def test_compressed_remat_policy_matches(mesh8):
+    """--grad-compress honours --remat-policy (not silently full remat)."""
+    fresh_state, x, y = _setup(mesh8)
+    plain, _ = make_compressed_step_fns(mesh8, cross_entropy_loss,
+                                        method="bf16")
+    sel, _ = make_compressed_step_fns(mesh8, cross_entropy_loss,
+                                      method="bf16", remat=True,
+                                      remat_policy="dots_no_batch")
+    s1, m1 = plain(fresh_state(), x, y)
+    s2, m2 = sel(fresh_state(), x, y)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                               rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    with pytest.raises(ValueError, match="unknown remat policy"):
+        make_compressed_step_fns(mesh8, cross_entropy_loss, method="bf16",
+                                 remat_policy="bogus")
