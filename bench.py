@@ -164,11 +164,13 @@ def _lm_throughput(*, batch, seq_len, steps, mesh, dtype, remat=False,
     """tokens/sec/chip + FLOPs/step for a CausalLM train step (flash
     attention + fused linear-cross-entropy head, weight-tied).
 
-    ``remat=True`` wraps the forward in ``jax.checkpoint`` (the same
-    whole-forward policy as ``train.step.make_step_fns(remat=True)``):
-    ~⅓ more FLOPs buys the activation memory back, so larger per-chip
-    batches fit — the lm_sweep validation section measures whether the
-    trade raises MFU at T=2048 like the playbook predicts."""
+    ``remat`` wraps the forward in ``jax.checkpoint``: ``True`` is the
+    whole-forward recompute-everything policy; a policy NAME from
+    ``train.step.REMAT_POLICIES`` (e.g. ``"dots_no_batch"``) keeps
+    matmul outputs so only elementwise chains recompute.  ~⅓ more FLOPs
+    (less under the dots policies) buys the activation memory back, so
+    larger per-chip batches fit — the lm_sweep validation section
+    measures whether the trade raises MFU at T=2048."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -200,7 +202,12 @@ def _lm_throughput(*, batch, seq_len, steps, mesh, dtype, remat=False,
             return model.loss(p, h, toks[:, 1:])
 
         if remat:
-            loss_fn = jax.checkpoint(loss_fn)
+            from distributed_deep_learning_tpu.train.step import (
+                _remat_policy)
+
+            policy = _remat_policy(remat if isinstance(remat, str)
+                                   else "nothing")
+            loss_fn = jax.checkpoint(loss_fn, policy=policy)
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state2 = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state2, loss

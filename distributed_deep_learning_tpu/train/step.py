@@ -23,6 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_deep_learning_tpu.data.loader import BATCH_AXES
 from distributed_deep_learning_tpu.train.objectives import prediction_metrics
 from distributed_deep_learning_tpu.train.state import TrainState
+from distributed_deep_learning_tpu.utils.config import REMAT_POLICIES
 
 LossFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
 
@@ -36,19 +37,10 @@ def _state_sharding(mesh: Mesh, state_spec):
     return jax.tree.map(lambda s: NamedSharding(mesh, s), state_spec)
 
 
-REMAT_POLICIES = {
-    # what the backward may REUSE without recomputing (jax.checkpoint
-    # `policy=`); "nothing" is classic full rematerialisation
-    "nothing": None,   # jax.checkpoint's default: recompute everything
-    "dots": "dots_saveable",
-    # the usual TPU sweet spot: keep matmul outputs whose operands have
-    # no batch dims (weights-side dots) — saves the expensive MXU work,
-    # recomputes the cheap elementwise chains
-    "dots_no_batch": "dots_with_no_batch_dims_saveable",
-}
-
-
 def _remat_policy(name: str):
+    """Resolve a REMAT_POLICIES name (the what-may-backward-reuse table,
+    shared with the CLI choices) to a jax.checkpoint policy; "nothing"
+    is classic full remat, the dots policies keep MXU outputs."""
     try:
         attr = REMAT_POLICIES[name]
     except KeyError:

@@ -23,6 +23,16 @@ import os
 from typing import Sequence
 
 
+#: --remat-policy name -> jax.checkpoint_policies attribute (None = the
+#: jax.checkpoint default: recompute everything).  Lives here (jax-free)
+#: so the CLI choices and train/step.py's resolver share one table.
+REMAT_POLICIES = {
+    "nothing": None,
+    "dots": "dots_saveable",
+    "dots_no_batch": "dots_with_no_batch_dims_saveable",
+}
+
+
 class Mode(str, enum.Enum):
     """Execution mode, 1:1 with the reference CLI (`-m`)."""
 
@@ -239,7 +249,7 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
                    help="recompute activations in backward (jax.checkpoint) "
                         "— trades FLOPs for HBM")
     p.add_argument("--remat-policy", dest="remat_policy", default="nothing",
-                   choices=["nothing", "dots", "dots_no_batch"],
+                   choices=sorted(REMAT_POLICIES),
                    help="with --remat: what backward may reuse — 'nothing' "
                         "recomputes all; 'dots'/'dots_no_batch' keep matmul "
                         "outputs so only elementwise chains recompute")
@@ -379,6 +389,10 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
     if args.checkpoint_every < 0:
         raise SystemExit(f"--checkpoint-every {args.checkpoint_every}: "
                          "must be >= 0")
+    if args.remat_policy != "nothing" and not args.remat:
+        raise SystemExit("--remat-policy requires --remat (a policy "
+                         "without rematerialisation would be a silent "
+                         "no-op)")
     return Config(
         num_layers=args.nlayers,
         size=args.size,

@@ -473,6 +473,13 @@ def _run_spmd_pipelined(spec: WorkloadSpec, config: Config, devices, logger,
         raise ValueError("--grad-compress targets the pure data-parallel "
                          "gradient all-reduce; the SPMD pipeline's gradient "
                          "dataflow is stage-sharded (use -m data)")
+    if config.remat_policy != "nothing" and \
+            config.pipeline_schedule in ("1f1b", "interleaved"):
+        # rejected BEFORE model build: the hand-scheduled pipeline
+        # backward hard-codes its own block remat, so a policy here
+        # would be a silent no-op
+        raise ValueError("--remat-policy has no effect under "
+                         "--pipeline-schedule 1f1b/interleaved")
     dp = n_dev // n_stages
     mesh = build_mesh({"data": dp, "stage": n_stages},
                       devices[:dp * n_stages])
@@ -502,11 +509,6 @@ def _run_spmd_pipelined(spec: WorkloadSpec, config: Config, devices, logger,
                                           remat=config.remat,
                                           remat_policy=config.remat_policy)
     if config.pipeline_schedule in ("1f1b", "interleaved"):
-        if config.remat_policy != "nothing":
-            # the hand-scheduled pipeline backward hard-codes its own
-            # block remat; a policy here would be a silent no-op
-            raise ValueError("--remat-policy has no effect under "
-                             "--pipeline-schedule 1f1b/interleaved")
         # hand-scheduled backward: O(stages) activation residency instead
         # of the scan-transpose's O(microbatches); interleaved additionally
         # fills the bubble with --virtual-stages chunks per device

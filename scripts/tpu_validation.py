@@ -203,7 +203,9 @@ def gqa_speedup(B=4, T=2048, H=8, Hkv=2, D=64, steps=10):
             "speedup": round(t_mha / t_gqa, 3)}
 
 
-def lm_sweep(configs=((16, False), (32, False), (32, True), (64, True)),
+def lm_sweep(configs=((16, False), (32, False), (32, True),
+                      (32, "dots_no_batch"), (64, True),
+                      (64, "dots_no_batch")),
              seq=2048, steps=10, **model_kw):
     """LM MFU playbook: per-chip batch × remat on the bench LM shape.
     The first hardware datum (batch 8, from the lm_tokens section —

@@ -155,3 +155,13 @@ def test_remat_with_grad_accum_rejected(monkeypatch):
             "--grad-accum", "2"]
     with pytest.raises(ValueError, match="--remat with --grad-accum"):
         run_workload(get_spec("mlp"), parse_args(argv, workload="mlp"))
+
+
+def test_remat_policy_without_remat_rejected():
+    """CLI principle: a policy without --remat is a silent no-op -> error."""
+    import pytest
+
+    from distributed_deep_learning_tpu.utils.config import parse_args
+
+    with pytest.raises(SystemExit, match="--remat-policy requires"):
+        parse_args(["-e", "1", "--remat-policy", "dots"], workload="mlp")
