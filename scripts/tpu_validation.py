@@ -213,10 +213,10 @@ def lm_sweep(configs=((16, False), (32, False), (32, True),
     under-batched at T=2048; remat rows test whether trading ~⅓ more
     FLOPs for activation residency lets a bigger batch raise MFU.
 
-    Each row PRINTS as its own JSON line the moment it completes: four
-    cold tunnel compiles can cross the 420 s section watchdog, and the
-    parent keeps whole printed lines on timeout, so completed rows
-    survive.  MFU for remat rows uses the model FLOPs/token from the
+    Each row PRINTS as its own JSON line the moment it completes: six
+    cold tunnel compiles WILL cross a single 420 s section watchdog, so
+    the parent grants this section a doubled budget AND keeps whole
+    printed lines on timeout — completed rows always survive.  MFU for remat rows uses the model FLOPs/token from the
     first successful non-remat row — cost_analysis FLOPs on a remat
     program include the recompute, which is HFU, not MFU; both are
     recorded.  Failing configs (OOM at 64×2048 is plausible) record the
@@ -373,12 +373,16 @@ def main():
         _run_section(sys.argv[2])
         return
     budget = float(os.environ.get("TPU_VALIDATION_SECTION_TIMEOUT", 420))
+    # lm_sweep runs 6 cold compiles; a single default budget would cut
+    # its tail rows (the 64-per-chip data the sweep exists to collect)
+    budgets = {"lm_sweep": 2 * budget}
     for name in SECTIONS:
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),
                  "--section", name],
-                timeout=budget, stdout=subprocess.PIPE, text=True)
+                timeout=budgets.get(name, budget),
+                stdout=subprocess.PIPE, text=True)
             sys.stdout.write(proc.stdout)
             sys.stdout.flush()
             if proc.returncode != 0 and not proc.stdout.strip():
@@ -397,7 +401,8 @@ def main():
                 out = out[:out.rfind("\n") + 1]
                 sys.stdout.write(out)
             print(json.dumps({"section": name,
-                              "error": f"timeout after {budget:.0f}s"}),
+                              "error": f"timeout after "
+                                       f"{budgets.get(name, budget):.0f}s"}),
                   flush=True)
 
 
