@@ -1,3 +1,5 @@
 from distributed_deep_learning_tpu.data.datasets import ArrayDataset  # noqa: F401
 from distributed_deep_learning_tpu.data.splits import Splits, train_val_test_split  # noqa: F401
 from distributed_deep_learning_tpu.data.loader import DeviceLoader  # noqa: F401
+from distributed_deep_learning_tpu.data.packed import (  # noqa: F401
+    PackedDataset, pack_dataset)

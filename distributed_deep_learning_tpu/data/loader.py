@@ -84,12 +84,14 @@ class DeviceLoader:
     def __iter__(self) -> Iterator[tuple[jax.Array, jax.Array]]:
         return self.iter_batches()
 
-    def iter_batches(self, skip: int = 0
-                     ) -> Iterator[tuple[jax.Array, jax.Array]]:
-        """Iterate the epoch's batches, optionally skipping the first
-        ``skip`` WITHOUT materialising them (mid-epoch resume: the skipped
+    def iter_host_batches(self, skip: int = 0
+                          ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        """This epoch's HOST-side (x, y) batches — the pure batch-formation
+        path (gather/decode, no device transfer), skipping the first
+        ``skip`` without materialising them (mid-epoch resume: the skipped
         batches were already trained before the checkpoint — no gather, no
-        decode, no device transfer for them)."""
+        decode, no device transfer for them).  ``scripts/feed_bench.py``
+        times exactly this iterator."""
         idx = self._epoch_indices()
         for start in range(skip * self.global_batch_size, len(idx),
                            self.global_batch_size):
@@ -99,8 +101,23 @@ class DeviceLoader:
             # materialise only this process's rows of the global batch
             local = batch_idx[self._local_rows] \
                 if jax.process_count() > 1 else batch_idx
-            x, y = self.dataset.batch(local)
-            yield self._to_device(x), self._to_device(y)
+            yield self.dataset.batch(local)
+
+    def iter_batches(self, skip: int = 0
+                     ) -> Iterator[tuple[jax.Array, jax.Array]]:
+        """Device-resident batches, double-buffered: batch k+1's sharded
+        ``device_put`` is enqueued BEFORE batch k is handed to the caller,
+        so its host→device transfer drains while the caller's step k
+        dispatch runs — one batch of transfer latency is always hidden,
+        even without :class:`PrefetchLoader`."""
+        prev = None
+        for x, y in self.iter_host_batches(skip):
+            cur = (self._to_device(x), self._to_device(y))
+            if prev is not None:
+                yield prev
+            prev = cur
+        if prev is not None:
+            yield prev
 
 
 class PrefetchLoader:

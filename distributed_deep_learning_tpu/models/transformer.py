@@ -398,14 +398,20 @@ class CausalLM(nn.Module):
         return params["params"]["embed"]["tok"]["embedding"]
 
     def loss(self, params, hidden, targets):
-        """Mean next-token cross-entropy via the fused head (pad id 0
-        excluded); pass ``tokens[:, :-1]`` hidden vs ``tokens[:, 1:]``."""
+        """Mean next-token cross-entropy via the fused head; positions
+        whose target equals ``self.pad_id`` are excluded, and with
+        ``pad_id=None`` every position counts (e.g. imported GPT-2, whose
+        id 0 is a real token).  Pass ``tokens[:, :-1]`` hidden vs
+        ``tokens[:, 1:]``."""
         from distributed_deep_learning_tpu.ops.fused_ce import (
             fused_linear_cross_entropy)
 
+        # -1 can never equal a vocab id, so it disables the exclusion
+        ignore_id = self.pad_id if self.pad_id is not None else -1
         return fused_linear_cross_entropy(
             hidden.astype(jnp.float32),
-            jnp.asarray(self._table(params), jnp.float32), targets)
+            jnp.asarray(self._table(params), jnp.float32), targets,
+            ignore_id)
 
     def logits_from(self, params, hidden):
         table = jnp.asarray(self._table(params), jnp.float32)
@@ -477,10 +483,12 @@ def generate(model: "CausalLM", params, prompt: jnp.ndarray, *,
 
     The prompt is prefilled in ONE multi-token cached call (the decode
     path's causal prefix mask keeps in-chunk attention causal), then each
-    new token is a 1-token step.  Pad positions (id 0) inside the prompt
-    are masked out of attention via the cache's validity buffer, but
-    generation always proceeds from each row's FINAL position — prefer
-    unpadded (or left-trimmed) prompts.
+    new token is a 1-token step.  Pad positions (id ``model.pad_id``)
+    inside the prompt are masked out of attention via the cache's
+    validity buffer (with ``pad_id=None`` — e.g. imported GPT-2 — every
+    prompt position is attended and nothing is masked), but generation
+    always proceeds from each row's FINAL position — prefer unpadded
+    (or left-trimmed) prompts.
     """
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got "

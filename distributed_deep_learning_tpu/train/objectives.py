@@ -41,15 +41,21 @@ def l1_loss(pred: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
 
 
 def token_cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray,
-                        label_smoothing: float = 0.0) -> jnp.ndarray:
+                        label_smoothing: float = 0.0,
+                        pad_id: int | None = 0) -> jnp.ndarray:
     """Mean CE over non-pad token positions: ``logits`` (..., T, V) vs
-    integer ids ``targets`` (..., T) where id 0 is pad/ignored — the loss
-    convention for the seq2seq and MLM north-star workloads (matching
-    :func:`prediction_metrics`' pad exclusion).
+    integer ids ``targets`` (..., T) where ``pad_id`` positions are
+    ignored — the loss convention for the seq2seq and MLM north-star
+    workloads (matching :func:`prediction_metrics`' pad exclusion).
+    ``pad_id`` defaults to the package's reserved id 0; ``None`` means no
+    padding id and every position counts (the :class:`..models.
+    transformer.CausalLM` ``pad_id=None`` convention, e.g. imported
+    GPT-2 where id 0 is a real token).
 
     ``label_smoothing`` ε spreads (1−ε) on the target id and ε/V on the
     rest (the transformer-base recipe, ε = 0.1 in the paper)."""
-    valid = (targets != 0).astype(jnp.float32)
+    valid = (targets != pad_id if pad_id is not None
+             else jnp.ones(targets.shape, bool)).astype(jnp.float32)
     tgt = jnp.maximum(targets, 0)
     if label_smoothing:
         V = logits.shape[-1]

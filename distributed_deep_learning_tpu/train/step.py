@@ -69,8 +69,10 @@ def make_step_fns(mesh: Mesh, loss_fn: LossFn, *,
     elementwise chains recompute — usually the better MFU trade on TPU,
     where the recomputed FLOPs would otherwise hit the MXU twice.
     """
-    policy = _remat_policy(remat_policy)  # eager: fail fast on typos,
-    state_sh = _state_sharding(mesh, state_spec)  # even when remat=False
+    # resolved eagerly (even when remat=False) so a typo'd policy name
+    # fails fast at build time
+    policy = _remat_policy(remat_policy)
+    state_sh = _state_sharding(mesh, state_spec)
     batch_sh = NamedSharding(mesh, batch_spec)
     repl = NamedSharding(mesh, P())
 

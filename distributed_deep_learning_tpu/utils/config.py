@@ -155,6 +155,9 @@ class Config:
     resume: bool = False
     profile_dir: str | None = None
     data_dir: str | None = None         # real-data root (ImageFolder layout)
+    packed_cache: str | None = None     # packed sample-cache artifact
+                                        #   (data/packed.py; overrides the
+                                        #   workload's dataset builder)
     image_size: int = 224               # decode size for --data-dir images
     stem_s2d: bool = False              # space-to-depth ResNet stem (TPU opt)
     attention: str = "auto"             # auto|dense|flash (transformer family)
@@ -280,6 +283,12 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
                    help="train on a real ImageFolder-layout dataset "
                         "(root/<class>/*.jpg) instead of the synthetic twin; "
                         "-w sets the decode thread count")
+    p.add_argument("--packed-cache", type=str, default=None, metavar="FILE",
+                   help="train from a packed pre-decoded sample cache "
+                        "(scripts/pack_dataset.py artifact): the cache is "
+                        "memory-mapped and batches assemble with zero "
+                        "per-sample Python work, instead of re-decoding "
+                        "--data-dir files every epoch")
     p.add_argument("--image-size", type=int, default=224,
                    help="square decode size for --data-dir images")
     p.add_argument("--window", dest="attention_window", type=int,
@@ -421,6 +430,7 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         resume=args.resume,
         profile_dir=args.profile_dir,
         data_dir=args.data_dir,
+        packed_cache=args.packed_cache,
         image_size=args.image_size,
         stem_s2d=args.stem_s2d,
         attention=args.attention,

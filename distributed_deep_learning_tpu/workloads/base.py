@@ -591,7 +591,7 @@ def run_workload(spec: WorkloadSpec, config: Config
                              f"gpt option; workload {spec.name!r} models "
                              "define their own head layout")
     try:
-        dataset = spec.build_dataset(config)
+        dataset = _build_dataset(spec, config)
         if spec.pre_train_check is not None:
             spec.pre_train_check(config, dataset)
         state, history = _run_workload(spec, config, devices, logger,
@@ -601,6 +601,20 @@ def run_workload(spec: WorkloadSpec, config: Config
         return state, history
     finally:
         logger.close()
+
+
+def _build_dataset(spec: WorkloadSpec, config: Config):
+    """``--packed-cache`` replaces the workload's dataset builder with the
+    mmap'd :class:`..data.packed.PackedDataset` — batches come straight
+    off the page cache instead of the per-epoch decode path.  The cache
+    carries the source's geometry metadata (classes / vocab / shapes), so
+    downstream model sizing is unchanged; it must have been packed from
+    the same workload's dataset (``scripts/pack_dataset.py``)."""
+    if config.packed_cache:
+        from distributed_deep_learning_tpu.data.packed import PackedDataset
+
+        return PackedDataset(config.packed_cache)
+    return spec.build_dataset(config)
 
 
 def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,

@@ -72,9 +72,16 @@ def _resnet_dataset(config: Config):
 def _resnet_geometry(config: Config, dataset):
     depth = config.size if config.size in _RESNET_LAYERS else 18
     num_classes = len(getattr(dataset, "classes", ())) or 10
-    # ImageFolder decode size decides the stem: small inputs (<=64 px, the
-    # CIFAR twin included) use the 3x3-s1 stem, ImageNet-size the 7x7-s2
-    small = config.image_size <= 64 if config.data_dir else True
+    # Decoded image size decides the stem: small inputs (<=64 px, the
+    # CIFAR twin included) use the 3x3-s1 stem, ImageNet-size the 7x7-s2.
+    # Materialised datasets (synthetic twins, --packed-cache) carry their
+    # size in the feature array; the lazy ImageFolder path decodes at
+    # --image-size.
+    feats = getattr(dataset, "features", None)
+    if feats is not None and feats.ndim == 4:
+        small = feats.shape[1] <= 64
+    else:
+        small = config.image_size <= 64 if config.data_dir else True
     return depth, num_classes, small
 
 

@@ -174,3 +174,63 @@ def test_prime_vocab_full_block_width():
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-6)
+
+
+def test_causal_lm_loss_threads_pad_id():
+    """CausalLM.loss must exclude ``model.pad_id`` positions — and with
+    ``pad_id=None`` count EVERY position (imported GPT-2, where id 0 is a
+    real token), instead of hard-coding id 0."""
+    import jax
+
+    from distributed_deep_learning_tpu.models.transformer import CausalLM
+
+    kw = dict(vocab_size=61, num_layers=1, d_model=16, num_heads=2,
+              mlp_dim=32, max_len=32)
+    toks = jax.random.randint(jax.random.key(0), (2, 13), 1, 61)
+    toks = toks.at[1, 9:].set(0)  # tail of id-0 positions
+    model0 = CausalLM(**kw)                 # pad_id=0 (default)
+    model_none = CausalLM(**kw, pad_id=None)
+    params = model0.init(jax.random.key(1), toks[:, :-1])
+    h = model0.apply(params, toks[:, :-1], train=False)
+    targets = toks[:, 1:]
+
+    def ref(model, ignore):
+        logp = jax.nn.log_softmax(model.logits_from(params, h), axis=-1)
+        picked = jnp.take_along_axis(logp, targets[..., None],
+                                     axis=-1)[..., 0]
+        valid = targets != ignore
+        return -jnp.sum(jnp.where(valid, picked, 0.0)) / jnp.sum(valid)
+
+    np.testing.assert_allclose(float(model0.loss(params, h, targets)),
+                               float(ref(model0, 0)), rtol=1e-5)
+    # pad_id=None: id-0 sites now COUNT (denominator grows, value shifts);
+    # hidden states come from model0 deliberately — same forward, only the
+    # loss masking differs
+    np.testing.assert_allclose(float(model_none.loss(params, h, targets)),
+                               float(ref(model_none, -1)), rtol=1e-5)
+    assert float(model0.loss(params, h, targets)) != pytest.approx(
+        float(model_none.loss(params, h, targets)))
+
+
+def test_token_cross_entropy_pad_id_param():
+    """objectives.token_cross_entropy: the ignored id is a parameter now
+    (``pad_id=None`` scores every position)."""
+    import jax
+
+    from distributed_deep_learning_tpu.train.objectives import (
+        token_cross_entropy)
+
+    logits = jax.random.normal(jax.random.key(0), (2, 6, 11))
+    targets = jnp.array([[3, 0, 5, 0, 1, 2], [4, 4, 0, 0, 0, 9]])
+    default = token_cross_entropy(logits, targets)
+    explicit0 = token_cross_entropy(logits, targets, pad_id=0)
+    np.testing.assert_allclose(float(default), float(explicit0))
+
+    none = token_cross_entropy(logits, targets, pad_id=None)
+    per = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    np.testing.assert_allclose(float(none), float(jnp.mean(per)), rtol=1e-6)
+
+    pad9 = token_cross_entropy(logits, targets, pad_id=9)
+    valid = targets != 9
+    want = jnp.sum(jnp.where(valid, per, 0.0)) / jnp.sum(valid)
+    np.testing.assert_allclose(float(pad9), float(want), rtol=1e-6)
