@@ -394,6 +394,34 @@ def _input_pipeline(*, mesh, dtype) -> dict | None:
     return out
 
 
+def _serving() -> dict | None:
+    """Serving throughput A/B (ISSUE 2): the continuous-batching engine
+    vs run-to-completion ``generate()`` on a seeded mixed-length trace —
+    CPU-measurable like ``input_pipeline`` (host scheduling + XLA decode
+    both run for real on the CI box; the TPU-shaped harvest lives in
+    ``scripts/tpu_validation.py``'s ``serving`` section).  Reports
+    tokens/sec both ways, the speedup, mean slot occupancy, and compile
+    counts (decode must be 1 — the compile-once contract)."""
+    from distributed_deep_learning_tpu.serve.bench import serving_bench
+
+    n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", 32))
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", 8))
+    rec = serving_bench(n_requests=n_req, max_slots=slots)
+    return {
+        "metric": "serving tokens/sec (mixed-length trace)",
+        "engine_tokens_per_sec": rec["engine"]["tokens_per_sec"],
+        "naive_tokens_per_sec": rec["naive"]["tokens_per_sec"],
+        "speedup": rec["speedup"],
+        "mean_slot_occupancy": rec["engine"]["mean_slot_occupancy"],
+        "decode_compiles": rec["engine"]["decode_compiles"],
+        "prefill_compiles": rec["engine"]["prefill_compiles"],
+        "naive_compiles": rec["naive"]["compiles"],
+        "naive_wasted_fraction": rec["naive"]["wasted_fraction"],
+        "max_slots": slots,
+        "requests": n_req,
+    }
+
+
 def _attention_speedup(steps: int = 20) -> float | None:
     """Fused (Pallas flash) vs dense attention fwd+bwd at a long-context
     shape; returns flash/dense step-time ratio > 1 = flash faster.  TPU
@@ -667,6 +695,25 @@ def main() -> None:
             print(f"bench: input-pipeline section failed "
                   f"({type(exc).__name__}: {exc})", file=sys.stderr)
 
+    # --- serving: continuous-batching engine vs naive generate() -----------
+    serving = None
+    t_serving = 120 if on_tpu else 60
+    if os.environ.get("BENCH_SERVE", "1") != "0" and \
+            _time_left() < t_serving:
+        print(f"bench: shedding serving section ({_time_left():.0f}s left)",
+              file=sys.stderr)
+    elif os.environ.get("BENCH_SERVE", "1") != "0":
+        try:
+            with _section_timer("serving"):
+                serving = _serving()
+            svs = _vs_baseline(baselines,
+                               f"{platform}:serving_tokens_per_sec_v1",
+                               serving["engine_tokens_per_sec"], base_path)
+            serving["vs_baseline"] = round(svs, 4)
+        except Exception as exc:
+            print(f"bench: serving section failed "
+                  f"({type(exc).__name__}: {exc})", file=sys.stderr)
+
     attn_speedup = None
     if on_tpu and os.environ.get("BENCH_ATTENTION", "1") != "0":
         if _time_left() < 90:
@@ -695,6 +742,7 @@ def main() -> None:
         "secondary": secondary,
         "lm": lm,
         "input_pipeline": input_pipe,
+        "serving": serving,
         "flash_attention_speedup":
             round(attn_speedup, 3) if attn_speedup else None,
         "section_secs": section_secs,
@@ -802,7 +850,7 @@ def orchestrate() -> int:
     # 720 s first-attempt timeout only ~170 s remained — a full section
     # set can never fit, but headline-only with a warm compile cache can).
     shed = {"BENCH_SECONDARY": "0", "BENCH_LM": "0", "BENCH_INPUT": "0",
-            "BENCH_ATTENTION": "0"}
+            "BENCH_ATTENTION": "0", "BENCH_SERVE": "0"}
     plan: list[dict] = [{}] if pinned else [
         {"BENCH_BATCH_PER_CHIP": "256"},
         {"BENCH_BATCH_PER_CHIP": "128", **shed},

@@ -462,6 +462,92 @@ def bert_base(**kw) -> BertEncoder:
     return BertEncoder(**kw)
 
 
+def make_decode_model(model: "CausalLM") -> "CausalLM":
+    """The KV-cached inference twin of a trained :class:`CausalLM`:
+    decode mode on, hidden-state output (the weight-tied head projects
+    only the positions that are sampled), dropout off.  Both
+    :func:`generate` and the continuous-batching engine
+    (:mod:`..serve.engine`) decode through this one clone recipe."""
+    return model.clone(decode=True, with_logits=False, dropout_rate=0.0)
+
+
+def init_cache(lm: "CausalLM", batch: int, total_len: int,
+               token_dtype=jnp.int32):
+    """Zeroed decode-cache pytree for ``batch`` rows of ``total_len``.
+
+    Cache buffers are zeros by construction, so they are shaped via
+    ``eval_shape`` — no full-length forward, no throwaway parameter
+    init.  ``lm`` must be a decode-mode model (:func:`make_decode_model`).
+    """
+    shapes = jax.eval_shape(lm.init, jax.random.key(0),
+                            jax.ShapeDtypeStruct((batch, total_len),
+                                                 token_dtype))
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                        shapes["cache"])
+
+
+def cached_apply(lm: "CausalLM", params, cache, tokens):
+    """One cached forward — a multi-token prefill chunk or a 1-token
+    decode step (the decode-mode causal prefix mask keeps in-chunk
+    attention causal either way).  Returns ``(hidden, new_cache)``.
+    The single implementation under both :func:`generate` and the
+    serving engine's prefill/decode programs."""
+    hidden, upd = lm.apply({"params": params, "cache": cache}, tokens,
+                           mutable=["cache"])
+    return hidden, upd["cache"]
+
+
+def validate_sampling(top_k: int | None, top_p: float | None) -> None:
+    """Host-side bounds check shared by every sampling entry point."""
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+
+def sample_tokens(model: "CausalLM", params, hidden_last, key, *,
+                  temperature: float = 0.0, top_k: int | None = None,
+                  top_p: float | None = None):
+    """Project final hidden states ``(B, d)`` through the weight-tied
+    head and pick one token per row; returns ``(tokens (B,), key)``.
+
+    THE sampler — :func:`generate` and the serving engine both call it,
+    so greedy/top-k/top-p semantics cannot drift between the batch and
+    continuous-batching paths.  Greedy at ``temperature == 0.0``, else
+    samples from ``softmax(logits / temperature)``; top-k and top-p
+    (nucleus) filters compose, k first then p, as in the common HF
+    semantics.  Top-k selection is ``jax.lax.top_k`` — O(V·k) partial
+    selection instead of a full per-step vocab sort.
+    """
+    nl = model.logits_from({"params": params}, hidden_last)  # (B, V)
+    if model.pad_id is not None:
+        # never emit the pad id: the cache records a generated pad as
+        # invalid (valid = tokens != pad_id), silently dropping that
+        # position from all subsequent attention and skewing the
+        # continuation (ADVICE r3).  pad_id=None (e.g. imported
+        # GPT-2, whose id 0 is a real token) has no such hazard.
+        nl = nl.at[:, model.pad_id].set(-jnp.inf)
+    if top_k is not None and top_k < nl.shape[-1]:
+        # mask everything below the k-th logit (static k — jit-safe)
+        kth = jax.lax.top_k(nl, top_k)[0][:, -1][:, None]
+        nl = jnp.where(nl >= kth, nl, -jnp.inf)
+    if temperature == 0.0:
+        return jnp.argmax(nl, axis=-1), key
+    scaled = nl / temperature
+    if top_p is not None and top_p < 1.0:
+        # nucleus: keep the smallest prefix of the sorted distribution
+        # whose mass reaches top_p (the crossing token included)
+        order = jnp.argsort(-scaled, axis=-1)
+        sp = jnp.take_along_axis(jax.nn.softmax(scaled, axis=-1),
+                                 order, axis=-1)
+        drop_sorted = jnp.cumsum(sp, axis=-1) - sp > top_p
+        drop = jnp.zeros_like(drop_sorted).at[
+            jnp.arange(nl.shape[0])[:, None], order].set(drop_sorted)
+        scaled = jnp.where(drop, -jnp.inf, scaled)
+    key, sub = jax.random.split(key)
+    return jax.random.categorical(sub, scaled), key
+
+
 def generate(model: "CausalLM", params, prompt: jnp.ndarray, *,
              max_new_tokens: int, temperature: float = 0.0,
              top_k: int | None = None, top_p: float | None = None,
@@ -493,72 +579,37 @@ def generate(model: "CausalLM", params, prompt: jnp.ndarray, *,
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got "
                          f"{max_new_tokens}")
+    validate_sampling(top_k, top_p)
     # hidden-state mode: project ONLY the final position through the
     # weight-tied head — prefill never materialises the (B, P, V) logits
-    lm = model.clone(decode=True, with_logits=False, dropout_rate=0.0)
+    lm = make_decode_model(model)
     B, P = prompt.shape
     total = P + max_new_tokens
     if total > model.max_len:
         raise ValueError(f"prompt {P} + {max_new_tokens} new tokens "
                          f"exceeds max_len {model.max_len}")
-    # cache buffers are zeros by construction: shape them via eval_shape
-    # (no full-length forward, no throwaway parameter init)
-    shapes = jax.eval_shape(lm.init, jax.random.key(0),
-                            jax.ShapeDtypeStruct((B, total), prompt.dtype))
-    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
-                         shapes["cache"])
+    cache = init_cache(lm, B, total, prompt.dtype)
     key0 = rng if rng is not None else jax.random.key(0)
 
-    if top_k is not None and top_k < 1:
-        raise ValueError(f"top_k must be >= 1, got {top_k}")
-    if top_p is not None and not 0.0 < top_p <= 1.0:
-        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-
     def pick(hidden_last, key):
-        nl = model.logits_from({"params": params}, hidden_last)  # (B, V)
-        if model.pad_id is not None:
-            # never emit the pad id: the cache records a generated pad as
-            # invalid (valid = tokens != pad_id), silently dropping that
-            # position from all subsequent attention and skewing the
-            # continuation (ADVICE r3).  pad_id=None (e.g. imported
-            # GPT-2, whose id 0 is a real token) has no such hazard.
-            nl = nl.at[:, model.pad_id].set(-jnp.inf)
-        if top_k is not None and top_k < nl.shape[-1]:
-            # mask everything below the k-th logit (static k — jit-safe)
-            kth = jnp.sort(nl, axis=-1)[:, -top_k][:, None]
-            nl = jnp.where(nl >= kth, nl, -jnp.inf)
-        if temperature == 0.0:
-            return jnp.argmax(nl, axis=-1), key
-        scaled = nl / temperature
-        if top_p is not None and top_p < 1.0:
-            # nucleus: keep the smallest prefix of the sorted distribution
-            # whose mass reaches top_p (the crossing token included)
-            order = jnp.argsort(-scaled, axis=-1)
-            sp = jnp.take_along_axis(jax.nn.softmax(scaled, axis=-1),
-                                     order, axis=-1)
-            drop_sorted = jnp.cumsum(sp, axis=-1) - sp > top_p
-            drop = jnp.zeros_like(drop_sorted).at[
-                jnp.arange(nl.shape[0])[:, None], order].set(drop_sorted)
-            scaled = jnp.where(drop, -jnp.inf, scaled)
-        key, sub = jax.random.split(key)
-        return jax.random.categorical(sub, scaled), key
+        return sample_tokens(model, params, hidden_last, key,
+                             temperature=temperature, top_k=top_k,
+                             top_p=top_p)
 
     # prefill: the whole prompt in ONE multi-token cached call (the
     # decode-mode causal prefix mask keeps in-chunk attention causal)
-    hidden, upd = lm.apply({"params": params, "cache": cache}, prompt,
-                           mutable=["cache"])
+    hidden, cache = cached_apply(lm, params, cache, prompt)
     first, key0 = pick(hidden[:, -1], key0)
     first = first.astype(prompt.dtype)
 
     def step(carry, _):
         cache, tok, key = carry
-        hidden, upd = lm.apply({"params": params, "cache": cache},
-                               tok[:, None], mutable=["cache"])
+        hidden, cache = cached_apply(lm, params, cache, tok[:, None])
         nxt, key = pick(hidden[:, -1], key)
-        return (upd["cache"], nxt.astype(tok.dtype), key), nxt
+        return (cache, nxt.astype(tok.dtype), key), nxt
 
     (_, _, _), toks = jax.lax.scan(
-        step, (upd["cache"], first, key0), None, length=max_new_tokens - 1)
+        step, (cache, first, key0), None, length=max_new_tokens - 1)
     return jnp.concatenate(
         [first[:, None], jnp.swapaxes(toks, 0, 1).astype(prompt.dtype)],
         axis=1)

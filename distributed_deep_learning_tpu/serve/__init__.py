@@ -1,0 +1,18 @@
+"""Continuous-batching inference for :class:`..models.transformer.CausalLM`.
+
+The serving analogue of the train stack's compile-once discipline
+(PAPERS.md "Scalable Training of Language Models using JAX pjit and
+TPUv4"): a slot-based static KV cache (:mod:`.cache`), a host-side slot
+scheduler (:mod:`.scheduler`), and an engine (:mod:`.engine`) whose
+decode hot path is ONE compiled XLA program for its whole lifetime —
+requests of any length enter and leave slots without changing a shape.
+:mod:`.bench` drives mixed-length request traces through the engine and
+the naive run-to-completion :func:`..models.transformer.generate`
+baseline.
+"""
+
+from distributed_deep_learning_tpu.serve.engine import ServeEngine
+from distributed_deep_learning_tpu.serve.scheduler import (Request,
+                                                           SlotScheduler)
+
+__all__ = ["ServeEngine", "Request", "SlotScheduler"]

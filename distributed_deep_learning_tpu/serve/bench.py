@@ -1,0 +1,185 @@
+"""Serving throughput harness: continuous batching vs run-to-completion.
+
+Drives the SAME seeded mixed-length request trace through both decode
+paths and reports one JSON-able record:
+
+* **engine** — :class:`..serve.engine.ServeEngine`: slot-based static KV
+  cache, bucketed compile-once prefill, one compiled decode program;
+  rows retire individually and freed slots refill immediately.
+* **naive**  — the batch-synchronous :func:`..models.transformer.generate`
+  baseline a framework without a serving layer would use: requests
+  grouped into fixed-size batches, prompts right-padded to the batch
+  max, every row decoded to the batch's LONGEST budget, and every new
+  ``(B, P, max_new)`` shape triple a fresh XLA compile.  (Padded rows
+  additionally sample their first token from a pad position — the naive
+  path is only CORRECT when all prompts in a batch share one length;
+  the engine's true-length prefill fixes that too.)
+
+Tokens/sec counts USEFUL tokens only — the ``max_new_tokens`` each
+request asked for — so the naive path's overshoot (decoding finished
+rows to the batch max) is wasted time, not credited throughput.  That
+asymmetry, plus per-shape recompiles, is precisely what continuous
+batching exists to eliminate; the record carries compile counts and
+mean slot occupancy so the mechanism is visible, not just the ratio.
+
+Shared by ``scripts/serve_bench.py`` (CLI), ``bench.py`` (the
+``serving`` sub-record) and ``scripts/tpu_validation.py`` (the TPU
+harvest section).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from distributed_deep_learning_tpu.serve.engine import (CountingJit,
+                                                        ServeEngine)
+from distributed_deep_learning_tpu.serve.scheduler import Request
+
+#: CPU-CI-sized default model geometry (big enough that a decode tick is
+#: real compute, small enough that the whole A/B fits a bench section)
+DEFAULT_MODEL = dict(vocab_size=512, num_layers=2, d_model=128,
+                     num_heads=4, mlp_dim=256, max_len=160)
+
+
+def build_model(seed: int = 0, **overrides):
+    """A randomly-initialised :class:`CausalLM` + params for serving
+    benches (throughput does not care that the weights are untrained)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_deep_learning_tpu.models.transformer import CausalLM
+
+    model = CausalLM(**{**DEFAULT_MODEL, **overrides})
+    toks = jnp.ones((1, 8), jnp.int32)
+    params = model.init(jax.random.key(seed), toks)["params"]
+    return model, params
+
+
+def make_trace(n_requests: int, *, vocab_size: int, seed: int = 0,
+               prompt_lens: tuple[int, int] = (4, 48),
+               new_tokens: tuple[int, int] = (4, 64),
+               stagger: int = 0) -> list[Request]:
+    """Seeded mixed-length trace.  ``prompt_lens``/``new_tokens`` are
+    inclusive uniform ranges; ``stagger`` is the mean inter-arrival gap
+    in decode ticks (0 = every request queued at tick 0)."""
+    rng = np.random.default_rng(seed)
+    reqs, tick = [], 0
+    for uid in range(n_requests):
+        p = int(rng.integers(prompt_lens[0], prompt_lens[1] + 1))
+        n = int(rng.integers(new_tokens[0], new_tokens[1] + 1))
+        prompt = rng.integers(1, vocab_size, p).astype(np.int32)
+        reqs.append(Request(uid, prompt, n, arrival_tick=tick))
+        if stagger:
+            tick += int(rng.integers(0, 2 * stagger + 1))
+    return reqs
+
+
+def run_engine(model, params, requests: Sequence[Request], **engine_kw):
+    """One engine lifetime over the trace; returns the engine's record."""
+    eng = ServeEngine(model, params, **engine_kw)
+    return eng.run(requests)
+
+
+def run_naive(model, params, requests: Sequence[Request],
+              batch_size: int) -> dict:
+    """The run-to-completion baseline at the same concurrency.
+
+    Batches of ``batch_size`` in submission order (arrival ticks are
+    ignored — generous to the baseline), padded to the batch max prompt
+    length, decoded to the batch max budget through a jitted
+    ``generate``.  Wall time includes the per-shape compiles: that IS
+    the naive path's serving cost.
+    """
+    import jax.numpy as jnp
+
+    from distributed_deep_learning_tpu.models.transformer import generate
+
+    pad_fill = model.pad_id if model.pad_id is not None else 0
+    gen = CountingJit(
+        lambda p, prompts, n: generate(model, p, prompts,
+                                       max_new_tokens=n),
+        static_argnums=(2,))
+
+    results: dict[int, np.ndarray] = {}
+    useful = decoded = 0
+    t0 = time.perf_counter()
+    for i in range(0, len(requests), batch_size):
+        batch = requests[i:i + batch_size]
+        pmax = max(len(r.prompt) for r in batch)
+        nmax = max(r.max_new_tokens for r in batch)
+        prompts = np.full((len(batch), pmax), pad_fill, np.int32)
+        for j, r in enumerate(batch):
+            prompts[j, :len(r.prompt)] = r.prompt
+        out = np.asarray(gen(params, jnp.asarray(prompts), nmax))
+        for j, r in enumerate(batch):
+            results[r.uid] = out[j, :r.max_new_tokens]
+            useful += r.max_new_tokens
+        decoded += len(batch) * nmax
+    total = time.perf_counter() - t0
+    return {"results": results, "stats": {
+        "requests": len(requests),
+        "generated_tokens": useful,
+        "decoded_tokens": decoded,
+        "wasted_fraction": round(1 - useful / decoded, 4) if decoded else 0,
+        "tokens_per_sec": useful / total if total else None,
+        "total_seconds": total,
+        "batch_size": batch_size,
+        "compiles": gen.traces,
+    }}
+
+
+def serving_bench(*, seed: int = 0, n_requests: int = 32,
+                  model_kw: Optional[dict] = None,
+                  prompt_lens: tuple[int, int] = (4, 48),
+                  new_tokens: tuple[int, int] = (4, 64),
+                  max_slots: int = 8,
+                  prefill_buckets: Optional[Sequence[int]] = None,
+                  stagger: int = 0, skip_naive: bool = False) -> dict:
+    """The full A/B at one configuration; returns the ``serving``
+    record ``bench.py`` embeds and ``scripts/serve_bench.py`` prints."""
+    model, params = build_model(seed, **(model_kw or {}))
+    if prompt_lens[1] + new_tokens[1] > model.max_len:
+        raise ValueError(
+            f"trace upper bounds {prompt_lens[1]}+{new_tokens[1]} exceed "
+            f"max_len {model.max_len}")
+    trace = make_trace(n_requests, vocab_size=model.vocab_size, seed=seed,
+                       prompt_lens=prompt_lens, new_tokens=new_tokens,
+                       stagger=stagger)
+
+    eng = run_engine(model, params, trace, max_slots=max_slots,
+                     prefill_buckets=prefill_buckets)
+    es = eng["stats"]
+    record = {
+        "metric": "serving throughput tokens/sec (mixed-length trace)",
+        "model": {**DEFAULT_MODEL, **(model_kw or {})},
+        "requests": n_requests,
+        "prompt_lens": list(prompt_lens),
+        "new_tokens": list(new_tokens),
+        "max_slots": max_slots,
+        "engine": {
+            "tokens_per_sec": round(es["tokens_per_sec"], 2),
+            "prefill_seconds": round(es["prefill_seconds"], 3),
+            "decode_seconds": round(es["decode_seconds"], 3),
+            "mean_slot_occupancy": round(es["mean_slot_occupancy"], 3),
+            "decode_ticks": es["decode_ticks"],
+            "prefill_compiles": es["prefill_compiles"],
+            "decode_compiles": es["decode_compiles"],
+            "buckets": es["buckets"],
+        },
+    }
+    if not skip_naive:
+        naive = run_naive(model, params, trace, batch_size=max_slots)
+        ns = naive["stats"]
+        record["naive"] = {
+            "tokens_per_sec": round(ns["tokens_per_sec"], 2),
+            "total_seconds": round(ns["total_seconds"], 3),
+            "wasted_fraction": ns["wasted_fraction"],
+            "compiles": ns["compiles"],
+        }
+        record["speedup"] = round(
+            es["tokens_per_sec"] / ns["tokens_per_sec"], 3) \
+            if ns["tokens_per_sec"] else None
+    return record

@@ -1,0 +1,257 @@
+"""Continuous-batching decode engine: two programs, compiled once.
+
+vLLM-style continuous batching mapped onto XLA's fixed-shape world:
+
+* **Decode** is ONE compiled program for the engine's lifetime — a
+  1-token step over ALL slots (the model's own tested single-sequence
+  cached decode, ``vmap``-ed over the slot axis of the static slot
+  table) followed by the shared sampling head.  Requests of any prompt
+  length, arriving at any time, never change its shapes.
+* **Prefill** is one compiled program PER POWER-OF-TWO BUCKET (a handful
+  for the engine's lifetime): the prompt is padded to the bucket, run as
+  one multi-token cached call, its position counters pinned back to the
+  true length (:func:`..serve.cache.fix_counters` — padding leaves no
+  numerical trace), and the filled cache written into the designated
+  slot.  Slot index and true length are traced scalars, so one program
+  serves every slot and every length inside a bucket.
+
+Both programs take the slot table as a DONATED argument on accelerator
+backends: the tick does not copy the cache in HBM, it updates it in
+place (donation is skipped on CPU, which does not implement it and
+would warn every call).
+
+Compilation counts are PROVEN, not assumed: each program runs through
+:class:`CountingJit`, whose counter increments at trace time only —
+``tests/test_serve.py`` asserts the decode count stays 1 across a trace
+of mixed lengths and staggered arrivals.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_deep_learning_tpu.models.transformer import (
+    CausalLM, cached_apply, make_decode_model, sample_tokens,
+    validate_sampling)
+from distributed_deep_learning_tpu.serve import cache as slot_cache
+from distributed_deep_learning_tpu.serve.scheduler import (Request,
+                                                           SlotScheduler)
+
+
+class CountingJit:
+    """``jax.jit`` wrapper that counts traces.
+
+    jit retraces exactly when a call presents a new (shape, dtype,
+    static-arg) signature — i.e. when it must compile — so the trace
+    count IS the compile count the tests assert on.  (A cache-evicted
+    retrace would also count: the counter is conservative, never
+    flattering.)
+    """
+
+    def __init__(self, fn, **jit_kwargs):
+        self.traces = 0
+
+        def counted(*args):
+            self.traces += 1   # runs at trace time only
+            return fn(*args)
+
+        self._jit = jax.jit(counted, **jit_kwargs)
+
+    def __call__(self, *args):
+        return self._jit(*args)
+
+
+def default_buckets(max_len: int, floor: int = 8) -> tuple[int, ...]:
+    """Powers of two from ``floor`` up to (and always including)
+    ``max_len`` — the prefill shape vocabulary."""
+    out = []
+    b = floor
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return tuple(out)
+
+
+class ServeEngine:
+    """Continuous-batching server for a trained :class:`CausalLM`.
+
+    ``run(requests)`` drives a whole trace; each tick advances every
+    active slot by one token, retires rows on EOS or budget, and
+    refills freed slots from the arrived queue — throughput tracks slot
+    occupancy, not the slowest request.
+    """
+
+    def __init__(self, model: CausalLM, params, *, max_slots: int = 8,
+                 max_len: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 eos_id: Optional[int] = None, temperature: float = 0.0,
+                 top_k: Optional[int] = None, top_p: Optional[float] = None,
+                 rng=None, donate: Optional[bool] = None):
+        validate_sampling(top_k, top_p)
+        self.model, self.params = model, params
+        self.lm = make_decode_model(model)
+        self.max_slots = int(max_slots)
+        self.max_len = int(max_len if max_len is not None else model.max_len)
+        if self.max_len > model.max_len:
+            raise ValueError(f"max_len {self.max_len} exceeds the model's "
+                             f"max_len {model.max_len}")
+        if prefill_buckets is None:
+            self.buckets = default_buckets(self.max_len)
+        else:
+            self.buckets = tuple(sorted({int(b) for b in prefill_buckets}))
+            if not self.buckets or self.buckets[0] < 1:
+                raise ValueError(f"bad prefill buckets {prefill_buckets}")
+            if self.buckets[-1] > self.max_len:
+                raise ValueError(f"prefill bucket {self.buckets[-1]} "
+                                 f"exceeds max_len {self.max_len}")
+            if self.buckets[-1] < self.max_len:
+                # top bucket: any admissible prompt must fit some bucket
+                self.buckets += (self.max_len,)
+        self.eos_id = eos_id
+        self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
+        # bucket padding uses the pad id (recorded invalid in the cache);
+        # pad-free models pad with id 0 — those positions are causally
+        # unreachable after the counter fixup, so the id never matters
+        self.pad_fill = model.pad_id if model.pad_id is not None else 0
+        self._key = rng if rng is not None else jax.random.key(0)
+        if donate is None:
+            donate = jax.default_backend() != "cpu"
+        dk = {"donate_argnums": (1,)} if donate else {}
+        self.slots = slot_cache.allocate_slots(self.lm, self.max_slots,
+                                               self.max_len)
+        self._prefill = CountingJit(self._prefill_impl, **dk)
+        self._decode = CountingJit(self._decode_impl, **dk)
+
+    # --- the two compiled programs ---------------------------------------
+    def _sample(self, hidden_last, key):
+        return sample_tokens(self.model, self.params, hidden_last, key,
+                             temperature=self.temperature,
+                             top_k=self.top_k, top_p=self.top_p)
+
+    def _prefill_impl(self, params, slots, tokens, slot, true_len, key):
+        """(Pb,)-padded prompt -> slot ``slot`` filled, first token out."""
+        fresh = slot_cache.fresh_slot(slots)
+        hidden, new = cached_apply(self.lm, params, fresh, tokens[None])
+        new = slot_cache.fix_counters(new, true_len)
+        slots = slot_cache.write_slot(slots, new, slot)
+        # sample from the TRUE final position, not the padded tail
+        h_last = jax.lax.dynamic_slice_in_dim(hidden[0], true_len - 1, 1)
+        tok, _ = self._sample(h_last, key)
+        return slots, tok[0]
+
+    def _decode_impl(self, params, slots, toks, key):
+        """One token for every slot: the model's single-sequence cached
+        decode vmapped over the slot axis, then one shared sampling."""
+        def one(per_slot, tok):
+            c = slot_cache.lift(per_slot)
+            hidden, new = cached_apply(self.lm, params, c, tok[None, None])
+            return slot_cache.unlift(new), hidden[0, 0]
+
+        slots, h = jax.vmap(one)(slots, toks)     # h: (max_slots, d)
+        toks, _ = self._sample(h, key)
+        return slots, toks
+
+    # --- host side --------------------------------------------------------
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.buckets:
+            if b >= prompt_len:
+                return b
+        raise ValueError(f"prompt length {prompt_len} exceeds the top "
+                         f"prefill bucket {self.buckets[-1]}")
+
+    def _validate(self, req: Request) -> None:
+        if len(req.prompt) + req.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"request {req.uid}: prompt {len(req.prompt)} + "
+                f"{req.max_new_tokens} new tokens exceeds the slot "
+                f"capacity max_len={self.max_len}")
+        self.bucket_for(len(req.prompt))
+
+    def _next_key(self):
+        if self.temperature == 0.0:
+            return self._key           # unused by greedy sampling
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def run(self, requests: Iterable[Request]) -> dict:
+        """Serve a whole trace; returns ``{"results", "stats"}``.
+
+        ``results`` maps uid -> generated token array; ``stats`` carries
+        the throughput/occupancy/compile accounting the serving bench
+        reports.
+        """
+        sched = SlotScheduler(self.max_slots)
+        n_req = 0
+        for req in requests:
+            self._validate(req)
+            sched.submit(req)
+            n_req += 1
+
+        t_start = time.perf_counter()
+        t_prefill = t_decode = 0.0
+        tick = prefill_calls = decode_ticks = occupancy_sum = 0
+        while sched.pending or sched.occupancy:
+            # admit every arrived request a free slot can take; a row
+            # retired below frees its slot for the very next tick's admit
+            while True:
+                placed = sched.place(tick)
+                if placed is None:
+                    break
+                idx, req = placed
+                pb = self.bucket_for(len(req.prompt))
+                padded = np.full(pb, self.pad_fill, np.int32)
+                padded[:len(req.prompt)] = req.prompt
+                t0 = time.perf_counter()
+                self.slots, tok = self._prefill(
+                    self.params, self.slots, jnp.asarray(padded),
+                    np.int32(idx), np.int32(len(req.prompt)),
+                    self._next_key())
+                first = int(tok)          # host fetch = device barrier
+                t_prefill += time.perf_counter() - t0
+                prefill_calls += 1
+                sched.record(idx, first, self.eos_id)
+
+            if not sched.occupancy:
+                nxt = sched.next_arrival()
+                if nxt is None:
+                    break
+                tick = max(tick, nxt)     # idle engine: jump to arrival
+                continue
+
+            occupancy_sum += sched.occupancy
+            t0 = time.perf_counter()
+            self.slots, out = self._decode(self.params, self.slots,
+                                           jnp.asarray(sched.last_tokens()),
+                                           self._next_key())
+            out = np.asarray(out)         # host fetch = device barrier
+            t_decode += time.perf_counter() - t0
+            decode_ticks += 1
+            for idx in sched.active_slots:
+                sched.record(idx, int(out[idx]), self.eos_id)
+            tick += 1
+
+        total = time.perf_counter() - t_start
+        tokens = int(sum(len(v) for v in sched.finished.values()))
+        stats = {
+            "requests": n_req,
+            "generated_tokens": tokens,
+            "tokens_per_sec": tokens / total if total else None,
+            "total_seconds": total,
+            "prefill_seconds": t_prefill,
+            "decode_seconds": t_decode,
+            "prefill_calls": prefill_calls,
+            "decode_ticks": decode_ticks,
+            "mean_slot_occupancy":
+                occupancy_sum / decode_ticks if decode_ticks else 0.0,
+            "max_slots": self.max_slots,
+            "prefill_compiles": self._prefill.traces,
+            "decode_compiles": self._decode.traces,
+            "buckets": list(self.buckets),
+        }
+        return {"results": sched.finished, "stats": stats}

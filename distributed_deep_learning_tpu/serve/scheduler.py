@@ -1,0 +1,132 @@
+"""Host-side slot scheduler: queue, occupancy, retirement.
+
+Pure Python bookkeeping — no JAX.  The engine owns the two compiled
+programs; this class decides WHICH request sits in WHICH slot at every
+tick, retires rows the moment they hit EOS or their token budget, and
+hands the freed slot to the next arrived request — so device throughput
+tracks slot occupancy instead of the slowest request in a batch
+(the failure mode of run-to-completion ``generate()``).
+
+Arrivals are measured in DECODE TICKS (``arrival_tick``), not wall
+seconds: a seeded trace then exercises identical scheduling decisions on
+any machine, which is what the compile-count and parity tests need.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.
+
+    ``prompt`` is a 1-D int token array; generation runs until
+    ``max_new_tokens`` tokens exist or the engine's ``eos_id`` is
+    emitted (EOS counts as the final token, mirroring the usual serving
+    contract).
+    """
+
+    uid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    arrival_tick: int = 0
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt)
+        if self.prompt.ndim != 1 or self.prompt.size < 1:
+            raise ValueError(f"request {self.uid}: prompt must be a "
+                             f"non-empty 1-D token array, got shape "
+                             f"{self.prompt.shape}")
+        if self.max_new_tokens < 1:
+            raise ValueError(f"request {self.uid}: max_new_tokens must "
+                             f"be >= 1, got {self.max_new_tokens}")
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: Optional[Request] = None
+    generated: Optional[list] = None
+
+    @property
+    def active(self) -> bool:
+        return self.request is not None
+
+
+class SlotScheduler:
+    """FIFO admission over a fixed slot table."""
+
+    def __init__(self, max_slots: int):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        self.slots = [_Slot() for _ in range(max_slots)]
+        self._queue: list[Request] = []   # arrival-tick then submit order
+        self.finished: dict[int, np.ndarray] = {}
+
+    # --- queue -----------------------------------------------------------
+    def submit(self, request: Request) -> None:
+        self._queue.append(request)
+        # stable sort: same-tick arrivals keep submission order
+        self._queue.sort(key=lambda r: r.arrival_tick)
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue)
+
+    @property
+    def active_slots(self) -> list[int]:
+        return [i for i, s in enumerate(self.slots) if s.active]
+
+    @property
+    def occupancy(self) -> int:
+        return sum(1 for s in self.slots if s.active)
+
+    def next_arrival(self) -> Optional[int]:
+        return self._queue[0].arrival_tick if self._queue else None
+
+    # --- placement / retirement ------------------------------------------
+    def place(self, tick: int) -> Optional[tuple[int, Request]]:
+        """Pop the next ARRIVED request into the lowest free slot, or
+        None when no slot is free / nothing has arrived yet."""
+        if not self._queue or self._queue[0].arrival_tick > tick:
+            return None
+        for i, slot in enumerate(self.slots):
+            if not slot.active:
+                req = self._queue.pop(0)
+                slot.request = req
+                slot.generated = []
+                return i, req
+        return None
+
+    def record(self, slot_idx: int, token: int,
+               eos_id: Optional[int]) -> Optional[Request]:
+        """Append one generated token to a slot; retire and return the
+        request when it hits EOS or its budget (else None).  The freed
+        slot is immediately placeable."""
+        slot = self.slots[slot_idx]
+        if not slot.active:
+            raise ValueError(f"slot {slot_idx} is not active")
+        slot.generated.append(int(token))
+        req = slot.request
+        done = len(slot.generated) >= req.max_new_tokens or \
+            (eos_id is not None and int(token) == eos_id)
+        if not done:
+            return None
+        self.finished[req.uid] = np.asarray(slot.generated,
+                                            dtype=req.prompt.dtype)
+        slot.request = None
+        slot.generated = None
+        return req
+
+    def last_tokens(self, fill: int = 0) -> np.ndarray:
+        """Per-slot feedback tokens for the next decode tick: the slot's
+        most recent token, ``fill`` for free slots (their compute is
+        discarded; the value only has to be a legal id)."""
+        out = np.full(len(self.slots), fill, np.int32)
+        for i, slot in enumerate(self.slots):
+            if slot.active and slot.generated:
+                out[i] = slot.generated[-1]
+        return out

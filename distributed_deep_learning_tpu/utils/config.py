@@ -164,6 +164,11 @@ class Config:
     attention_window: int | None = None  # sliding-window size (flash, causal)
     optimizer: str = "auto"             # auto|sgd|momentum|adam|adamw|...
     generate_tokens: int = 0            # gpt: sample N tokens post-train
+    serve: bool = False                 # gpt: post-train continuous-batching
+                                        #   serving demo (serve/engine.py)
+    max_slots: int = 8                  # serving: concurrent decode slots
+    prefill_buckets: tuple[int, ...] | None = None  # serving: prefill pad
+                                        #   lengths (None = powers of two)
     pos_embedding: str = "learned"      # learned | rope (gpt)
     num_kv_heads: int | None = None     # grouped-query attention (gpt)
     label_smoothing: float = 0.0        # token-CE smoothing (LM families)
@@ -336,6 +341,22 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
                         "continuations of two dataset prompts (KV-cached "
                         "decode; a smoke sample — the prompts are usually "
                         "training rows, not held-out data)")
+    p.add_argument("--serve", action="store_true",
+                   help="gpt: after training, serve a mixed-length batch "
+                        "of dataset prompts through the continuous-"
+                        "batching engine (slot-based KV cache, compile-"
+                        "once decode) and log tokens/sec + occupancy — "
+                        "the serving sibling of --generate")
+    p.add_argument("--max-slots", dest="max_slots", type=int, default=8,
+                   metavar="S",
+                   help="serving: concurrent decode slots (the engine's "
+                        "static batch dimension; throughput tracks slot "
+                        "occupancy)")
+    p.add_argument("--prefill-buckets", dest="prefill_buckets", type=str,
+                   default=None, metavar="L1,L2,...",
+                   help="serving: comma-separated prompt-padding bucket "
+                        "lengths — one compiled prefill program each "
+                        "(default: powers of two up to the cache length)")
     p.add_argument("--schedule", dest="lr_schedule",
                    choices=["none", "cosine", "rsqrt", "step"],
                    default="none",
@@ -373,6 +394,20 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
                         "instead of hanging the collective")
     p.add_argument("--heartbeat-timeout", type=float, default=30.0)
     return p
+
+
+def parse_buckets_arg(text: str | None) -> tuple[int, ...] | None:
+    if not text:
+        return None
+    try:
+        buckets = tuple(int(b) for b in text.split(","))
+    except ValueError:
+        raise SystemExit(f"--prefill-buckets {text!r}: expected "
+                         "comma-separated integers") from None
+    if any(b < 1 for b in buckets):
+        raise SystemExit(f"--prefill-buckets {text!r}: lengths must be "
+                         ">= 1")
+    return buckets
 
 
 def parse_mesh_arg(text: str | None) -> dict[str, int] | None:
@@ -437,6 +472,9 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         attention_window=args.attention_window,
         optimizer=args.optimizer,
         generate_tokens=args.generate_tokens,
+        serve=args.serve,
+        max_slots=args.max_slots,
+        prefill_buckets=parse_buckets_arg(args.prefill_buckets),
         pos_embedding=args.pos_embedding,
         num_kv_heads=args.num_kv_heads,
         label_smoothing=args.label_smoothing,

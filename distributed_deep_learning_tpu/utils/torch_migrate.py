@@ -55,28 +55,57 @@ def _typed_groups(state_dict) -> list[tuple[str, dict]]:
     appears twice in ``state_dict()`` with tensors sharing storage —
     torch serialisation preserves the sharing, so the duplicate group's
     data pointers match the first occurrence and it is skipped.
+
+    A numpy/safetensors ROUND-TRIP loses that storage sharing (every
+    entry materialises as its own array), so when no tensor in the
+    state_dict carries a ``data_ptr`` the detector falls back to VALUE
+    equality: a prefix group whose full leaf set (names, shapes, dtypes,
+    bytes) exactly duplicates an earlier group's is treated as the same
+    aliased registration.  The fallback never engages for torch-saved
+    checkpoints (pointers stay authoritative there), and an exact
+    whole-group duplicate among TRAINED weights is, in practice, only
+    ever the double registration.
     """
-    def _ptr(val) -> int:
+    def _ptr(val):
         if hasattr(val, "data_ptr"):      # torch tensor (incl. loaded)
             return val.data_ptr()
-        return id(val)
+        return None                       # numpy/safetensors round-trip
 
     # single pass: prefix -> leaves and pointer sets (insertion-ordered)
     raw: dict[str, dict] = {}
-    ptrs: dict[str, set[int]] = {}
+    ptrs: dict[str, set] = {}
     for key, val in state_dict.items():
         prefix, _, leaf = key.rpartition(".")
         raw.setdefault(prefix, {})[leaf] = val
         ptrs.setdefault(prefix, set()).add(_ptr(val))
 
+    have_ptrs = all(None not in s for s in ptrs.values())
+
+    def _fingerprint(leaves: dict) -> tuple:
+        import hashlib
+
+        out = []
+        for name in sorted(leaves):
+            arr = np.ascontiguousarray(leaves[name])
+            out.append((name, arr.shape, str(arr.dtype),
+                        hashlib.sha256(arr.tobytes()).hexdigest()))
+        return tuple(out)
+
     order: list[str] = []
     by_prefix: dict[str, dict] = {}
-    seen_ptrs: set[int] = set()
+    seen_ptrs: set = set()
+    seen_values: set = set()
     for prefix, leaves in raw.items():
-        if ptrs[prefix] <= seen_ptrs:
+        if have_ptrs and ptrs[prefix] <= seen_ptrs:
             continue  # every tensor aliases an earlier registration
         seen_ptrs |= ptrs[prefix]
-        by_prefix[prefix] = {k: _to_np(v) for k, v in leaves.items()}
+        group = {k: _to_np(v) for k, v in leaves.items()}
+        if not have_ptrs:
+            fp = _fingerprint(group)
+            if fp in seen_values:
+                continue  # exact whole-group duplicate: aliased
+            seen_values.add(fp)
+        by_prefix[prefix] = group
         order.append(prefix)
 
     groups: list[tuple[str, dict]] = []

@@ -557,10 +557,11 @@ def run_workload(spec: WorkloadSpec, config: Config
     devices = _devices(config)
     logger = PhaseLogger(verbose=is_coordinator(),
                          jsonl_path=config.metrics_file)
-    if config.generate_tokens and spec.post_train is None:
+    if (config.generate_tokens or config.serve) and spec.post_train is None:
         # rejected, not silently dropped (same principle as staged-mode
         # flag validation below)
-        raise ValueError(f"--generate is not supported by workload "
+        flag = "--generate" if config.generate_tokens else "--serve"
+        raise ValueError(f"{flag} is not supported by workload "
                          f"{spec.name!r} (gpt only)")
     if config.pos_embedding != "learned" and spec.name != "gpt":
         raise ValueError(f"--pos {config.pos_embedding} is a gpt option; "
@@ -596,7 +597,8 @@ def run_workload(spec: WorkloadSpec, config: Config
             spec.pre_train_check(config, dataset)
         state, history = _run_workload(spec, config, devices, logger,
                                        dataset)
-        if config.generate_tokens and spec.post_train is not None:
+        if (config.generate_tokens or config.serve) and \
+                spec.post_train is not None:
             spec.post_train(config, state, logger, dataset)
         return state, history
     finally:

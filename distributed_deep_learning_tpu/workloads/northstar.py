@@ -533,6 +533,50 @@ def _gpt_generate(config: Config, state, logger, dataset) -> None:
         logger.info(f"generate prompt={row_p} continuation={row_o}")
 
 
+def _gpt_serve(config: Config, state, logger, dataset) -> None:
+    """``--serve``: push a seeded mixed-length request trace (prompts
+    drawn over the dataset's vocabulary) through the continuous-batching
+    engine (serve/engine.py) on the just-trained weights and log
+    tokens/sec, mean slot occupancy and compile counts — the
+    serving-path sibling of ``--generate``'s batch-synchronous smoke
+    sample."""
+    from distributed_deep_learning_tpu.serve.bench import (make_trace,
+                                                           run_engine)
+
+    params = getattr(state, "params", None)
+    if isinstance(params, dict) and "params" in params:
+        params = params["params"]
+    if not isinstance(params, dict) or "embed" not in params:
+        logger.info("serve skipped: --serve needs the whole-model "
+                    "parameter tree (-m data or sequential)")
+        return
+    model = _gpt_model(config, dataset)
+    seq = dataset.features.shape[1]
+    # prompt + budget must fit the slot capacity (the model's max_len,
+    # dataset-derived and possibly tiny in smoke runs)
+    p_hi = max(2, min(_GENERATE_PROMPT_LEN, seq, model.max_len - 1))
+    new_hi = max(1, min(config.generate_tokens or 16,
+                        model.max_len - p_hi))
+    trace = make_trace(max(2 * config.max_slots, 8),
+                       vocab_size=_vocab(dataset), seed=config.seed,
+                       prompt_lens=(2, p_hi), new_tokens=(1, new_hi))
+    out = run_engine(model, params, trace, max_slots=config.max_slots,
+                     prefill_buckets=config.prefill_buckets)
+    s = out["stats"]
+    logger.info(
+        f"serve: {s['requests']} requests, {s['generated_tokens']} tokens "
+        f"at {s['tokens_per_sec']:.1f} tok/s, occupancy "
+        f"{s['mean_slot_occupancy']:.2f}/{s['max_slots']}, compiles "
+        f"prefill={s['prefill_compiles']} decode={s['decode_compiles']}")
+
+
+def _gpt_post(config: Config, state, logger, dataset) -> None:
+    if config.generate_tokens:
+        _gpt_generate(config, state, logger, dataset)
+    if config.serve:
+        _gpt_serve(config, state, logger, dataset)
+
+
 GPT_SPEC = WorkloadSpec(
     name="gpt",
     build_dataset=_gpt_dataset,
@@ -546,7 +590,7 @@ GPT_SPEC = WorkloadSpec(
                                           jnp.int32),
     tp_rules=lambda c: transformer_tp_rules(),
     build_pipelined=_gpt_pipelined,
-    post_train=_gpt_generate,
+    post_train=_gpt_post,
     pre_train_check=_gpt_pre_check,
 )
 

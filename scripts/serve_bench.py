@@ -1,0 +1,88 @@
+"""Serving throughput bench: continuous batching vs naive generate().
+
+Drives a seeded mixed-length request trace (uniform prompt/output length
+distributions, optional staggered arrivals) through the slot-based
+continuous-batching engine (``serve/engine.py``) AND the batch-
+synchronous run-to-completion ``generate()`` baseline, then prints ONE
+JSON line: tokens/sec for both paths, the speedup, the engine's
+prefill/decode time split, mean slot occupancy, and per-path compile
+counts (the engine's decode program compiles ONCE for the whole trace;
+the naive path recompiles per ``(B, P, max_new)`` shape).
+
+    JAX_PLATFORMS=cpu python scripts/serve_bench.py            # defaults
+    python scripts/serve_bench.py --requests 64 --max-slots 16 \
+        --prompt-max 96 --new-max 128 --max-len 256            # heavier
+
+Defaults are CPU-CI sized (~15 s); see PERFORMANCE.md §Serving for
+recorded numbers and the bucket-granularity trade-offs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _script_env() -> None:
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="serving throughput: continuous-batching engine vs "
+                    "run-to-completion generate()")
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--max-slots", type=int, default=8)
+    p.add_argument("--prompt-min", type=int, default=4)
+    p.add_argument("--prompt-max", type=int, default=48)
+    p.add_argument("--new-min", type=int, default=4)
+    p.add_argument("--new-max", type=int, default=64)
+    p.add_argument("--stagger", type=int, default=0,
+                   help="mean inter-arrival gap in decode ticks "
+                        "(0 = all requests queued up front)")
+    p.add_argument("--buckets", type=str, default=None,
+                   help="comma-separated prefill bucket lengths "
+                        "(default: powers of two up to max-len)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--skip-naive", action="store_true",
+                   help="engine only (e.g. profiling the hot path)")
+    # model geometry (default: CPU-CI-sized, serve/bench.py)
+    p.add_argument("--layers", type=int, default=None)
+    p.add_argument("--d-model", type=int, default=None)
+    p.add_argument("--heads", type=int, default=None)
+    p.add_argument("--mlp-dim", type=int, default=None)
+    p.add_argument("--vocab", type=int, default=None)
+    p.add_argument("--max-len", type=int, default=None)
+    p.add_argument("--out", default=None, help="also write the JSON here")
+    args = p.parse_args(argv)
+
+    from distributed_deep_learning_tpu.serve.bench import serving_bench
+
+    model_kw = {k: v for k, v in (
+        ("num_layers", args.layers), ("d_model", args.d_model),
+        ("num_heads", args.heads), ("mlp_dim", args.mlp_dim),
+        ("vocab_size", args.vocab), ("max_len", args.max_len),
+    ) if v is not None}
+    buckets = [int(b) for b in args.buckets.split(",")] \
+        if args.buckets else None
+    record = serving_bench(
+        seed=args.seed, n_requests=args.requests, model_kw=model_kw,
+        prompt_lens=(args.prompt_min, args.prompt_max),
+        new_tokens=(args.new_min, args.new_max),
+        max_slots=args.max_slots, prefill_buckets=buckets,
+        stagger=args.stagger, skip_naive=args.skip_naive)
+    out = json.dumps(record)
+    print(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    _script_env()
+    sys.exit(main())

@@ -324,6 +324,31 @@ def mfu_diag(batches=(128, 256)):
             "rows": rows}
 
 
+def serving(n_requests=48, max_slots=16):
+    """Continuous-batching engine vs naive generate() at a TPU-shaped
+    geometry (GPT-2-small-ish trunk, long mixed-length trace).  On TPU
+    the per-tick device time is small, so this also measures the host
+    round-trip share of the tick — the datum that decides whether the
+    next engine iteration needs multi-tick device loops."""
+    import jax
+
+    from distributed_deep_learning_tpu.serve.bench import serving_bench
+
+    on_tpu = jax.default_backend() == "tpu"
+    model_kw = (dict(vocab_size=32768, num_layers=12, d_model=768,
+                     num_heads=12, mlp_dim=3072, max_len=1024)
+                if on_tpu else
+                dict(vocab_size=512, num_layers=2, d_model=128,
+                     num_heads=4, mlp_dim=256, max_len=192))
+    rec = serving_bench(
+        n_requests=n_requests if on_tpu else 8,
+        max_slots=max_slots if on_tpu else 4,
+        model_kw=model_kw,
+        prompt_lens=(16, 256) if on_tpu else (4, 32),
+        new_tokens=(16, 256) if on_tpu else (4, 16))
+    return {"section": "serving", "on_tpu": on_tpu, **rec}
+
+
 def _record_flash_gate(result: dict) -> None:
     """Persist the measured ratio as the `--attention auto` gate datum."""
     from distributed_deep_learning_tpu.utils.bench_records import (
@@ -333,8 +358,8 @@ def _record_flash_gate(result: dict) -> None:
 
 
 SECTIONS = ("flash_block_sweep", "flash_vs_dense", "gqa_speedup",
-            "s2d_vs_plain", "batch_sweep", "lm_tokens", "mfu_diag",
-            "lm_sweep")
+            "s2d_vs_plain", "batch_sweep", "lm_tokens", "serving",
+            "mfu_diag", "lm_sweep")
 
 
 def _run_section(name: str) -> None:

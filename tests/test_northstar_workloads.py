@@ -197,6 +197,23 @@ def test_generate_flag_rejected_for_non_gpt():
                              "--generate", "4"], limit=128)
 
 
+def test_gpt_serve_flag(capsys):
+    """--serve runs the continuous-batching engine on the trained
+    weights post-train and logs throughput/occupancy/compile counts."""
+    _, h = _run("gpt", ["-l", "1", "-s", "32", "-e", "1", "-b", "16",
+                        "--serve", "--max-slots", "2",
+                        "--prefill-buckets", "4,8"], limit=128)
+    _ok(h)
+    out = capsys.readouterr().out
+    assert "serve:" in out and "tok/s" in out and "decode=1" in out
+
+
+def test_serve_flag_rejected_for_non_gpt():
+    with pytest.raises(ValueError, match="--serve"):
+        _run("resnet", ["-s", "18", "-e", "1", "-b", "16", "--serve"],
+             limit=64)
+
+
 def test_adamw_decay_mask_exempts_vectors():
     """Weight decay must skip biases/norm scales (ndim < 2)."""
     import jax.numpy as jnp

@@ -288,3 +288,63 @@ def test_gpt2_rejects_model_larger_than_checkpoint():
     with pytest.raises(ValueError, match="missing from the checkpoint"):
         causal_lm_params_from_hf_gpt2(
             hf.state_dict(), model, jnp.ones((1, 4), jnp.int32))
+
+
+def test_aliased_dedupe_survives_numpy_roundtrip():
+    """A numpy round-trip (e.g. via safetensors) loses the storage
+    sharing the data_ptr dedupe keys on; the value-equality fallback
+    must still drop the double-registered group."""
+    from distributed_deep_learning_tpu.models.mlp import MLP
+
+    hidden, classes, features = 38, 5, 48
+
+    class Twin(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.l_in = torch.nn.Linear(features, hidden)
+            self.add_module("alias", self.l_in)   # WrapperTriton pattern
+            self.l_h = torch.nn.Linear(hidden, hidden)
+            self.head = torch.nn.Linear(hidden, classes)
+
+        def forward(self, x):
+            x = torch.relu(self.l_in(x))
+            x = torch.relu(self.l_h(x))
+            return self.head(x)
+
+    tm = Twin().eval()
+    x = np.random.default_rng(5).normal(size=(4, features)) \
+        .astype(np.float32)
+    with torch.no_grad():
+        want = tm(torch.from_numpy(x)).numpy()
+    model = MLP(hidden_size=hidden, num_hidden_layers=1,
+                num_classes=classes)
+
+    # torch dict: pointer-based dedupe (the existing path)
+    v1 = mlp_params_from_torch(tm.state_dict(), model, x[:1])
+    np.testing.assert_allclose(model.apply(v1, x), want, atol=ATOL)
+
+    # numpy round-trip: every tensor its own array, no data_ptr
+    rt = {k: v.detach().cpu().numpy().copy()
+          for k, v in tm.state_dict().items()}
+    v2 = mlp_params_from_torch(rt, model, x[:1])
+    np.testing.assert_allclose(model.apply(v2, x), want, atol=ATOL)
+
+
+def test_numpy_roundtrip_without_aliases_not_overdeduped():
+    """The value fallback must NOT merge distinct groups that merely
+    share shapes (trained/random weights differ in value)."""
+    from distributed_deep_learning_tpu.models.mlp import MLP
+
+    tm = torch.nn.Sequential(
+        torch.nn.Linear(48, 38), torch.nn.ReLU(),
+        torch.nn.Linear(38, 38), torch.nn.ReLU(),
+        torch.nn.Linear(38, 38), torch.nn.ReLU(),
+        torch.nn.Linear(38, 5)).eval()
+    x = np.random.default_rng(6).normal(size=(2, 48)).astype(np.float32)
+    with torch.no_grad():
+        want = tm(torch.from_numpy(x)).numpy()
+    rt = {k: v.detach().cpu().numpy().copy()
+          for k, v in tm.state_dict().items()}
+    model = MLP(hidden_size=38, num_hidden_layers=2, num_classes=5)
+    variables = mlp_params_from_torch(rt, model, x[:1])
+    np.testing.assert_allclose(model.apply(variables, x), want, atol=ATOL)
