@@ -422,6 +422,21 @@ def _serving() -> dict | None:
     }
 
 
+def _resilience() -> dict | None:
+    """Self-healing drill (ISSUE 3): detection latency of the anomaly
+    sentinel, checkpoint-corruption fallback, and elastic recovery wall
+    time, measured by the same code path ``scripts/chaos_drill.py``
+    exposes.  CPU-measurable (host + XLA logic).  The sentinel is OFF in
+    every other bench section, so the headline numbers are regression-free
+    by construction; ``sentinel_overhead_frac`` quantifies what turning it
+    on would cost on this (tiny, worst-case) model."""
+    from distributed_deep_learning_tpu.utils.chaos import run_resilience_drill
+
+    rec = run_resilience_drill(seed=int(os.environ.get("BENCH_CHAOS_SEED",
+                                                       "0")))
+    return {"metric": "self-healing drill (chaos-injected)", **rec}
+
+
 def _attention_speedup(steps: int = 20) -> float | None:
     """Fused (Pallas flash) vs dense attention fwd+bwd at a long-context
     shape; returns flash/dense step-time ratio > 1 = flash faster.  TPU
@@ -714,6 +729,21 @@ def main() -> None:
             print(f"bench: serving section failed "
                   f"({type(exc).__name__}: {exc})", file=sys.stderr)
 
+    # --- resilience: the self-healing chain under injected faults ----------
+    resilience = None
+    t_res = 90 if on_tpu else 60
+    if os.environ.get("BENCH_RESILIENCE", "1") != "0" and \
+            _time_left() < t_res:
+        print(f"bench: shedding resilience section ({_time_left():.0f}s "
+              "left)", file=sys.stderr)
+    elif os.environ.get("BENCH_RESILIENCE", "1") != "0":
+        try:
+            with _section_timer("resilience"):
+                resilience = _resilience()
+        except Exception as exc:
+            print(f"bench: resilience section failed "
+                  f"({type(exc).__name__}: {exc})", file=sys.stderr)
+
     attn_speedup = None
     if on_tpu and os.environ.get("BENCH_ATTENTION", "1") != "0":
         if _time_left() < 90:
@@ -743,6 +773,7 @@ def main() -> None:
         "lm": lm,
         "input_pipeline": input_pipe,
         "serving": serving,
+        "resilience": resilience,
         "flash_attention_speedup":
             round(attn_speedup, 3) if attn_speedup else None,
         "section_secs": section_secs,
@@ -850,7 +881,8 @@ def orchestrate() -> int:
     # 720 s first-attempt timeout only ~170 s remained — a full section
     # set can never fit, but headline-only with a warm compile cache can).
     shed = {"BENCH_SECONDARY": "0", "BENCH_LM": "0", "BENCH_INPUT": "0",
-            "BENCH_ATTENTION": "0", "BENCH_SERVE": "0"}
+            "BENCH_ATTENTION": "0", "BENCH_SERVE": "0",
+            "BENCH_RESILIENCE": "0"}
     plan: list[dict] = [{}] if pinned else [
         {"BENCH_BATCH_PER_CHIP": "256"},
         {"BENCH_BATCH_PER_CHIP": "128", **shed},

@@ -125,12 +125,16 @@ def tp_state_spec(state: Any, rules: Rules) -> Any:
             lambda _: P(), node)
 
     opt_specs = jax.tree.map(opt_map, state.opt_state, is_leaf=params_like)
+    kw = {}
+    if getattr(state, "sentinel", None) is not None:
+        kw["sentinel"] = jax.tree.map(lambda _: P(), state.sentinel)
     return state.replace(
         step=P(),
         params=p_specs,
         model_state=jax.tree.map(lambda _: P(), state.model_state),
         opt_state=opt_specs,
         rng=P() if getattr(state, "rng", None) is not None else None,
+        **kw,
     )
 
 

@@ -66,6 +66,7 @@ def zero1_state_spec(state: TrainState, mesh: Mesh, *, axis: str = "fsdp",
         model_state=_replicated(state.model_state),
         opt_state=_tree_specs(state.opt_state, n, axis, min_leaf_size),
         rng=P() if state.rng is not None else None,
+        sentinel=_replicated(state.sentinel),  # four scalars, replicated
     )
 
 
@@ -79,4 +80,5 @@ def fsdp_state_spec(state: TrainState, mesh: Mesh, *, axis: str = "fsdp",
         model_state=_replicated(state.model_state),
         opt_state=_tree_specs(state.opt_state, n, axis, min_leaf_size),
         rng=P() if state.rng is not None else None,
+        sentinel=_replicated(state.sentinel),  # four scalars, replicated
     )

@@ -180,16 +180,29 @@ class ServeEngine:
         return sub
 
     def run(self, requests: Iterable[Request]) -> dict:
-        """Serve a whole trace; returns ``{"results", "stats"}``.
+        """Serve a whole trace; returns ``{"results", "errors", "stats"}``.
 
         ``results`` maps uid -> generated token array; ``stats`` carries
         the throughput/occupancy/compile accounting the serving bench
         reports.
+
+        Validation is PER REQUEST at submit: an invalid request (oversize
+        prompt, prompt + ``max_new_tokens`` beyond the slot capacity) is
+        recorded under ``errors`` (uid -> message) and the rest of the
+        batch completes — one bad request must not abort every other
+        request already queued behind it.  (Malformed :class:`Request`
+        construction still raises where the request is BUILT — that bug
+        belongs to the caller, not the batch.)
         """
         sched = SlotScheduler(self.max_slots)
         n_req = 0
+        errors: dict[int, str] = {}
         for req in requests:
-            self._validate(req)
+            try:
+                self._validate(req)
+            except ValueError as e:
+                errors[req.uid] = str(e)
+                continue
             sched.submit(req)
             n_req += 1
 
@@ -240,6 +253,7 @@ class ServeEngine:
         tokens = int(sum(len(v) for v in sched.finished.values()))
         stats = {
             "requests": n_req,
+            "rejected": len(errors),
             "generated_tokens": tokens,
             "tokens_per_sec": tokens / total if total else None,
             "total_seconds": total,
@@ -254,4 +268,4 @@ class ServeEngine:
             "decode_compiles": self._decode.traces,
             "buckets": list(self.buckets),
         }
-        return {"results": sched.finished, "stats": stats}
+        return {"results": sched.finished, "errors": errors, "stats": stats}

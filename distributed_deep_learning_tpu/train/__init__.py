@@ -6,3 +6,6 @@ from distributed_deep_learning_tpu.train.objectives import (  # noqa: F401
 )
 from distributed_deep_learning_tpu.train.step import make_step_fns  # noqa: F401
 from distributed_deep_learning_tpu.train.loop import fit, EpochResult  # noqa: F401
+from distributed_deep_learning_tpu.train.sentinel import (  # noqa: F401
+    AnomalyError, SentinelConfig, attach_sentinel,
+)

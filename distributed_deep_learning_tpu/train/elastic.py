@@ -21,23 +21,33 @@ from __future__ import annotations
 from typing import Any, Callable, Sequence
 
 from distributed_deep_learning_tpu.train.loop import EpochResult, fit
+from distributed_deep_learning_tpu.train.sentinel import AnomalyError
 from distributed_deep_learning_tpu.utils.checkpoint import Checkpointer
 from distributed_deep_learning_tpu.utils.failures import (FailureMonitor,
                                                           WorkerFailure)
 from distributed_deep_learning_tpu.utils.logging import PhaseLogger
 
 
-def resume_point(checkpointer: Checkpointer
+class RestartLoopError(RuntimeError):
+    """The same resume point died twice with the identical failure —
+    replaying it further could only repeat it (deterministic bug, or a
+    permanently dead peer), so elastic recovery gives up early instead of
+    burning ``max_restarts`` on the loop."""
+
+
+def resume_point(checkpointer: Checkpointer, step: int | None = None
                  ) -> tuple[int | None, int, int, dict | None]:
-    """Decode the latest checkpoint into a resume point.
+    """Decode a checkpoint (default: latest) into a resume point.
 
     Returns ``(ckpt_step, start_epoch, resume_batch, resume_totals)``:
     ``ckpt_step`` is the orbax id to restore (None = start fresh);
     ``resume_batch > 0`` means mid-epoch — skip that many batches of
     ``start_epoch`` and seed the phase totals with ``resume_totals``.
     Sidecar-less checkpoints (pre-round-5 run dirs) keep the legacy
-    convention step == completed epoch."""
-    last = checkpointer.latest_step()
+    convention step == completed epoch.  Pass ``step`` when integrity
+    fallback restored an OLDER step than latest — the resume point must
+    describe the checkpoint actually restored, not the quarantined one."""
+    last = checkpointer.latest_step() if step is None else step
     if last is None:
         return None, 1, 0, None
     extra = checkpointer.read_extra(last)
@@ -70,34 +80,55 @@ def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
                       logger: PhaseLogger | None = None,
                       monitor: FailureMonitor | None = None,
                       max_restarts: int = 2,
-                      checkpoint_every: int | None = None
+                      checkpoint_every: int | None = None,
+                      sentinel=None, chaos=None
                       ) -> tuple[Any, list[EpochResult]]:
     """Run :func:`..loop.fit` with checkpointed restart on failure.
 
     ``make_state`` builds a FRESH initial state (used as the restore
     target; called once per attempt so donated buffers from the failed
     attempt are never reused).  Failures caught: :class:`WorkerFailure`
-    from the monitor and runtime errors surfaced by JAX; after
-    ``max_restarts`` recoveries the last error propagates.
-    ``checkpoint_every=N`` saves every N train steps and recovers from the
-    last step boundary (loader position rides the checkpoint sidecar).
+    from the monitor, runtime errors surfaced by JAX, and transient
+    shared-FS ``OSError``; after ``max_restarts`` recoveries the last
+    error propagates.  ``checkpoint_every=N`` saves every N train steps
+    and recovers from the last step boundary (loader position rides the
+    checkpoint sidecar).
+
+    Robustness wiring (ISSUE 3):
+
+    * Restores go through :meth:`Checkpointer.restore_verified` — a torn
+      or bit-flipped latest save is quarantined and recovery proceeds
+      from the previous verified-good step, resume point included.
+    * A **restart loop** — the same ``(ckpt_step, epoch, batch)`` resume
+      point dying twice with the identical error — fails fast instead of
+      burning every restart replaying a deterministic bug.
+    * ``sentinel`` with ``policy="rollback"``: an
+      :class:`..train.sentinel.AnomalyError` restores the last checkpoint
+      and replays with the offending global step in the run's skip set
+      (the poisoned data window is consumed, never trained).
+    * The ``monitor`` is :meth:`~..utils.failures.FailureMonitor.reset`
+      between attempts, so a recorded failure from the dead attempt does
+      not permanently poison the retries (the replacement worker is
+      expected to heartbeat again).
     """
     logger = logger or PhaseLogger(verbose=False)
     train_loader, val_loader, test_loader = loaders
     restarts = 0
+    skip_steps: set[int] = set()  # rollback policy's poisoned data windows
+    prev_failure = None           # (resume point, error) of the last attempt
     sink: list[EpochResult] = []  # survives attempts (round-5 fix: the
     # returned history used to hold only the FINAL attempt's epochs)
     while True:
         state = make_state()
-        # flush in-flight async saves BEFORE reading the resume point: a
-        # step save scheduled just before the failure must be visible to
-        # this retry, or it would resume from an older boundary and try to
-        # re-save an id that then finalises under it (review finding)
-        checkpointer.wait_until_finished()
-        ckpt_step, start_epoch, resume_batch, resume_totals = \
-            resume_point(checkpointer)
+        # restore_verified flushes in-flight async saves BEFORE reading the
+        # resume point: a step save scheduled just before the failure must
+        # be visible to this retry, or it would resume from an older
+        # boundary and try to re-save an id that then finalises under it
+        restored, ckpt_step = checkpointer.restore_verified(state)
         if ckpt_step is not None:
-            state = checkpointer.restore(state, step=ckpt_step) or state
+            state = restored
+            _, start_epoch, resume_batch, resume_totals = \
+                resume_point(checkpointer, step=ckpt_step)
             # loud on purpose: an elastic (re)launch over an existing dir
             # silently continuing the OLD run would be the dirty-dir
             # hazard _maybe_checkpointer refuses for non-elastic runs
@@ -105,6 +136,8 @@ def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
                 if resume_batch else f"epoch {start_epoch}"
             logger.info(f"elastic: restored checkpoint step {ckpt_step}; "
                         f"continuing from {at}")
+        else:
+            start_epoch, resume_batch, resume_totals = 1, 0, None
         try:
             if monitor is not None:
                 monitor.raise_if_failed()
@@ -118,9 +151,36 @@ def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
                            start_epoch=start_epoch, monitor=monitor,
                            checkpoint_every=checkpoint_every,
                            resume_batch=resume_batch,
-                           resume_totals=resume_totals, history_sink=sink)
+                           resume_totals=resume_totals, history_sink=sink,
+                           sentinel=sentinel, chaos=chaos,
+                           skip_steps=skip_steps or None)
             return state, _merge_history(sink)
-        except (WorkerFailure, RuntimeError) as e:
+        except AnomalyError as e:
+            if e.policy != "rollback":
+                raise  # halt: clean state as of the last good step
+            restarts += 1
+            if restarts > max_restarts:
+                raise
+            skip_steps.add(e.global_step)
+            checkpointer.wait_until_finished()
+            logger.info(f"sentinel rollback ({e}); restart "
+                        f"{restarts}/{max_restarts} with global step "
+                        f"{e.global_step} in the skip window")
+            if monitor is not None and hasattr(monitor, "reset"):
+                monitor.reset()
+        except (WorkerFailure, RuntimeError, OSError) as e:
+            failure = ((ckpt_step, start_epoch, resume_batch),
+                       type(e).__name__, str(e))
+            if failure == prev_failure:
+                # deterministic bug, not a transient fault: replaying it
+                # max_restarts times would reach the identical state and
+                # die identically — say so now, with the evidence
+                raise RestartLoopError(
+                    "restart loop — same failure at the same resume point "
+                    f"(checkpoint {ckpt_step}, epoch {start_epoch}, batch "
+                    f"{resume_batch}) twice in a row: {type(e).__name__}: "
+                    f"{e}") from e
+            prev_failure = failure
             restarts += 1
             if restarts > max_restarts:
                 raise
@@ -132,3 +192,5 @@ def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
             at = f"epoch {ep} step {b}" if b else f"epoch {ep}"
             logger.info(f"recovering from failure ({type(e).__name__}: {e}); "
                         f"restart {restarts}/{max_restarts} from {at}")
+            if monitor is not None and hasattr(monitor, "reset"):
+                monitor.reset()

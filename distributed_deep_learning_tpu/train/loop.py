@@ -45,12 +45,16 @@ def _sum_totals(device_metrics, init_totals=None):
     else:
         totals = {"loss": 0.0, "correct": 0, "count": 0}
     if init_totals:
-        totals = {k: totals.get(k, 0) + init_totals[k] for k in init_totals}
+        # union of keys: a sidecar saved before a metric existed (e.g. the
+        # sentinel's `anomaly` counter) must not erase it from the totals
+        keys = set(totals) | set(init_totals)
+        totals = {k: totals.get(k, 0) + init_totals.get(k, 0) for k in keys}
     return totals
 
 
 def _run_phase(step_fn, state, loader, *, train: bool, monitor=None,
-               skip: int = 0, init_totals=None, on_step=None):
+               skip: int = 0, init_totals=None, on_step=None,
+               batch_hook=None, skip_pred=None, check_anomaly=None):
     """Drive one phase; returns (state, totals) with one host sync at end.
 
     ``skip`` batches are consumed-but-not-trained (mid-epoch resume: the
@@ -59,8 +63,20 @@ def _run_phase(step_fn, state, loader, *, train: bool, monitor=None,
     ``on_step(batch_idx, state, totals_fn)`` fires after every trained
     step — the step-checkpoint/chaos hook; ``totals_fn()`` materialises
     the running totals only when actually needed (a save), keeping the
-    per-step path sync-free."""
+    per-step path sync-free.
+
+    ``batch_hook(batch_idx, x, y) -> (x, y)`` may replace a batch before
+    the step (the chaos NaN/spike injector) or raise (an injected worker
+    failure).  ``skip_pred(batch_idx)`` consumes a batch without training
+    it (the rollback policy's poisoned-window replay).
+    ``check_anomaly(batch_idx, metrics)`` inspects the sentinel verdict
+    with a ONE-STEP lag: step *i*'s scalar is read after step *i+1* is
+    dispatched, so the device pipeline stays busy and detection still
+    lands within one step.  Anomalous steps were already contained on
+    device, so even the saves ``on_step`` makes in that lag window hold
+    clean state."""
     device_metrics = []
+    pending = None  # (batch_idx, metrics) awaiting the lag-1 anomaly check
     if skip and hasattr(loader, "iter_batches"):
         batches = loader.iter_batches(skip)  # skipped without materialising
     else:
@@ -73,13 +89,23 @@ def _run_phase(step_fn, state, loader, *, train: bool, monitor=None,
             # mid-epoch surfaces HERE instead of hanging the next collective
             monitor.raise_if_failed()
         if train:
+            if skip_pred is not None and skip_pred(i + 1):
+                continue  # poisoned data window: consumed, never trained
+            if batch_hook is not None:
+                x, y = batch_hook(i + 1, x, y)
             state, m = step_fn(state, x, y)
         else:
             m = step_fn(state, x, y)
         device_metrics.append(m)
+        if check_anomaly is not None:
+            if pending is not None:
+                check_anomaly(*pending)
+            pending = (i + 1, m)
         if on_step is not None:
             on_step(i + 1, state,
                     lambda: _sum_totals(device_metrics, init_totals))
+    if pending is not None:
+        check_anomaly(*pending)
     return state, _sum_totals(device_metrics, init_totals)
 
 
@@ -100,7 +126,8 @@ def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
         checkpointer=None, start_epoch: int = 1, monitor=None,
         checkpoint_every: int | None = None, resume_batch: int = 0,
         resume_totals: dict | None = None,
-        history_sink: list | None = None
+        history_sink: list | None = None,
+        sentinel=None, chaos=None, skip_steps=None
         ) -> tuple[TrainState, list[EpochResult]]:
     """Drive the epoch loop.  With a ``checkpointer``
     (:class:`..utils.checkpoint.Checkpointer`) the state is saved after
@@ -124,7 +151,17 @@ def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
     ``history_sink`` (a list) receives every EpochResult AS PRODUCED, so a
     caller that catches a mid-run failure still holds the completed
     phases' records — :func:`..elastic.fit_with_recovery` passes one sink
-    across attempts and the merged run history survives restarts."""
+    across attempts and the merged run history survives restarts.
+
+    ``sentinel`` (:class:`..train.sentinel.SentinelConfig`) must match the
+    config ``train_step`` was built with; here it selects the HOST policy:
+    under ``rollback``/``halt`` the per-step verdict is checked with a
+    one-step lag and :class:`..train.sentinel.AnomalyError` raised; under
+    ``skip`` contained steps are just counted and logged at phase end.
+    ``chaos`` (:class:`..utils.chaos.ChaosPlan`) injects planned faults
+    into train batches; ``skip_steps`` (a set of GLOBAL train-step ids) is
+    the rollback replay's poisoned window — those batches are consumed but
+    never trained."""
     logger = logger or PhaseLogger(verbose=False)
     history: list[EpochResult] = \
         [] if history_sink is None else history_sink
@@ -156,6 +193,9 @@ def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
                 "or batch size) — use a fresh --checkpoint-dir or the "
                 "original flags")
 
+    enforce = sentinel is not None and sentinel.policy in ("rollback",
+                                                           "halt")
+
     for epoch in range(start_epoch, epochs + 1):  # reference counts from 1
         maybe_inject_failure(epoch)  # chaos drill (DDL_INJECT_FAILURE)
         train_loader.set_epoch(epoch)
@@ -174,11 +214,37 @@ def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
                            "epoch_complete": False,
                            "totals": {k: float(v) for k, v in t.items()}})
 
+        batch_hook = skip_pred = check_anomaly = None
+        if chaos is not None:
+            def batch_hook(b, x, y, _epoch=epoch):
+                return chaos.batch_hook((_epoch - 1) * spe + b, x, y)
+        if skip_steps:
+            def skip_pred(b, _epoch=epoch):
+                return (_epoch - 1) * spe + b in skip_steps
+        if enforce:
+            def check_anomaly(b, m, _epoch=epoch):
+                if float(m["anomaly"]):
+                    from distributed_deep_learning_tpu.train.sentinel import (
+                        AnomalyError)
+
+                    raise AnomalyError((_epoch - 1) * spe + b,
+                                       sentinel.policy,
+                                       int(float(m["anomaly_code"])))
+
         t0 = logger.phase_begin("train", epoch)
         state, totals = _run_phase(train_step, state, train_loader,
                                    train=True, monitor=monitor, skip=skip,
-                                   init_totals=init_totals, on_step=on_step)
+                                   init_totals=init_totals, on_step=on_step,
+                                   batch_hook=batch_hook,
+                                   skip_pred=skip_pred,
+                                   check_anomaly=check_anomaly)
         t1 = logger.clock()
+        if sentinel is not None and totals.get("anomaly"):
+            # contained on device — say so (the run's health story must be
+            # visible in the log, not only in the metrics file)
+            logger.info(f"sentinel: contained {int(totals['anomaly'])} "
+                        f"anomalous step(s) in epoch {epoch} "
+                        f"(policy={sentinel.policy})")
         res = _result("train", epoch, totals, t0, t1)
         logger.phase_end("train", epoch, accuracy=res.accuracy, loss=res.loss)
         # beyond-reference observability: throughput counters per phase

@@ -1,0 +1,194 @@
+"""On-device anomaly sentinel: detect and contain bad updates in-step.
+
+The failure this closes is *silent numerical poisoning*: one batch with a
+NaN (a corrupt record, a bit-flipped host buffer) or one pathological
+gradient spike updates the params, the checkpointer then immortalises the
+poisoned state, and elastic recovery faithfully restores it — the recovery
+chain amplifies the fault instead of containing it.  Production pjit/TPU
+runs treat loss spikes as routine events, not exceptions ("Scalable
+Training of Language Models using JAX pjit and TPUv4", PAPERS.md §skipping
+anomalous batches), so the defence has to live on the hot path.
+
+Mechanism: the sentinel runs INSIDE the jitted train step.  After the
+backward it computes the global gradient norm, checks loss and grad-norm
+finiteness, and compares both against exponential running means kept in a
+four-scalar :class:`SentinelState` threaded through the step.  When the
+step is anomalous the already-computed update is *discarded on device* —
+every state leaf takes a ``jnp.where(anomaly, old, new)`` select, so the
+params/optimizer/step/rng-stream are bit-identical to never having trained
+that batch.  No host synchronisation is added: the verdict rides the
+per-step metrics dict the loop already keeps on device.
+
+Policies (:class:`SentinelConfig.policy`) decide what the HOST does with a
+detected anomaly — the device-side containment above happens under all of
+them, so params are safe even before the host notices:
+
+``skip``
+    Nothing: the batch's update is dropped, training continues.  Skips are
+    counted in the phase totals (``anomaly`` metric) and logged.
+``rollback``
+    The loop raises :class:`AnomalyError`;
+    :func:`..train.elastic.fit_with_recovery` restores the last verified
+    checkpoint and replays the epoch with the offending global step in its
+    skip set — used when a bad batch should also invalidate optimizer-state
+    history, or under chaos drills that corrupt state outside the step.
+``halt``
+    The loop raises :class:`AnomalyError` and nothing catches it: the run
+    stops with the state clean as of the last good step.
+
+Detection latency is at most one step: the loop checks the PREVIOUS step's
+verdict right after dispatching the next one (the scalar is already on its
+way to the host), so rollback/halt fire within a step of the anomaly while
+the device pipeline stays busy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+#: anomaly verdict codes carried in the per-step ``anomaly_code`` metric
+OK, NONFINITE, GRAD_SPIKE, LOSS_SPIKE = 0, 1, 2, 3
+
+_CODE_NAMES = {NONFINITE: "non-finite loss/grad",
+               GRAD_SPIKE: "gradient-norm spike",
+               LOSS_SPIKE: "loss spike"}
+
+POLICIES = ("skip", "rollback", "halt")
+
+
+class AnomalyError(RuntimeError):
+    """Raised by the loop when the sentinel policy is rollback/halt.
+
+    The offending update was already discarded on device — the state the
+    loop holds is clean as of the last good step; ``global_step`` names
+    the data window to skip on replay."""
+
+    def __init__(self, global_step: int, policy: str, code: int,
+                 detail: str = ""):
+        self.global_step = int(global_step)
+        self.policy = policy
+        self.code = int(code)
+        what = _CODE_NAMES.get(self.code, "anomaly")
+        super().__init__(
+            f"anomaly sentinel: {what} at global train step {global_step} "
+            f"(policy={policy}{'; ' + detail if detail else ''})")
+
+
+@dataclasses.dataclass(frozen=True)
+class SentinelConfig:
+    """Static sentinel configuration (baked into the compiled step).
+
+    ``window`` is the EMA horizon in steps for the running grad-norm/loss
+    means; ``spike_factor``/``loss_spike_factor`` are the multiples of
+    those means that count as a spike; the first ``warmup_steps`` clean
+    steps only feed the means (no spike verdicts — the very first steps of
+    a run legitimately have wild norms).  Finiteness is always checked,
+    warmup included."""
+
+    policy: str = "skip"
+    window: int = 32
+    spike_factor: float = 10.0
+    loss_spike_factor: float = 10.0
+    warmup_steps: int = 8
+
+    def __post_init__(self):
+        if self.policy not in POLICIES:
+            raise ValueError(f"sentinel policy {self.policy!r}: choose from "
+                             f"{POLICIES}")
+        if self.window < 1 or self.warmup_steps < 1:
+            raise ValueError("sentinel window and warmup_steps must be >= 1")
+        if self.spike_factor <= 1.0 or self.loss_spike_factor <= 1.0:
+            raise ValueError("sentinel spike factors must be > 1")
+
+
+@flax.struct.dataclass
+class SentinelState:
+    """Four device scalars threaded through the jitted step."""
+
+    grad_ema: jax.Array   # running mean of the global grad norm
+    loss_ema: jax.Array   # running mean of the batch loss
+    count: jax.Array      # clean steps observed (drives warmup)
+    anomalies: jax.Array  # cumulative anomalous steps (contained)
+
+
+def init_sentinel() -> SentinelState:
+    # four DISTINCT arrays: sharing one zeros() buffer across fields would
+    # donate the same buffer twice in the jitted step (donate_argnums=(0,))
+    return SentinelState(grad_ema=jnp.zeros((), jnp.float32),
+                         loss_ema=jnp.zeros((), jnp.float32),
+                         count=jnp.zeros((), jnp.int32),
+                         anomalies=jnp.zeros((), jnp.int32))
+
+
+def attach_sentinel(state):
+    """Return ``state`` with a fresh :class:`SentinelState` attached.
+
+    Must run BEFORE sharding specs are derived from the state (the spec
+    builders map the sentinel scalars to replicated specs)."""
+    return state.replace(sentinel=init_sentinel())
+
+
+def guarded_update(state, grads, new_ms, metrics, cfg: SentinelConfig):
+    """The sentinel step body: verdict, containment, stats update.
+
+    Runs inside the jitted train step.  Returns ``(new_state, metrics)``
+    where ``metrics`` gains ``anomaly`` (0/1), ``anomaly_code`` and
+    ``grad_norm``, and the task metrics of an anomalous step are zeroed —
+    phase totals then equal those of a run that never saw the bad batch
+    (the bit-identical containment contract ``tests/test_chaos.py``
+    asserts)."""
+    sen = state.sentinel
+    if sen is None:
+        raise ValueError("sentinel config given but state has no sentinel "
+                         "state — build the state via attach_sentinel()")
+    gnorm = optax.global_norm(grads)
+    loss = metrics["loss"]
+    finite = jnp.isfinite(gnorm) & jnp.isfinite(loss)
+    warm = sen.count >= cfg.warmup_steps
+    g_spike = warm & (gnorm > cfg.spike_factor * sen.grad_ema)
+    l_spike = warm & (loss > cfg.loss_spike_factor * sen.loss_ema)
+    anomaly = ~finite | g_spike | l_spike
+    code = jnp.where(~finite, NONFINITE,
+                     jnp.where(g_spike, GRAD_SPIKE,
+                               jnp.where(l_spike, LOSS_SPIKE, OK)))
+
+    candidate = state.apply_gradients(grads, model_state=new_ms)
+
+    def contain(new, old):
+        return jax.tree.map(lambda n, o: jnp.where(anomaly, o, n), new, old)
+
+    # EMA over clean steps only (an anomalous norm must not inflate the
+    # very threshold that flagged it); the first clean step seeds the mean
+    alpha = 1.0 / cfg.window
+    first = sen.count == 0
+
+    def ema(prev, x):
+        seeded = jnp.where(first, x, (1.0 - alpha) * prev + alpha * x)
+        return jnp.where(anomaly, prev, seeded)
+
+    new_sen = SentinelState(
+        grad_ema=ema(sen.grad_ema, gnorm),
+        loss_ema=ema(sen.loss_ema, loss),
+        count=sen.count + jnp.where(anomaly, 0, 1).astype(jnp.int32),
+        anomalies=sen.anomalies + anomaly.astype(jnp.int32))
+
+    new_state = candidate.replace(
+        step=jnp.where(anomaly, state.step, candidate.step),
+        params=contain(candidate.params, state.params),
+        model_state=contain(candidate.model_state, state.model_state),
+        opt_state=contain(candidate.opt_state, state.opt_state),
+        sentinel=new_sen)
+
+    # anomalous steps contribute nothing to the phase totals — neither the
+    # (possibly NaN) loss nor the sample count
+    out = {k: jnp.where(anomaly, jnp.zeros_like(v), v)
+           for k, v in metrics.items()}
+    out["anomaly"] = anomaly.astype(jnp.float32)
+    out["anomaly_code"] = code.astype(jnp.float32)
+    out["grad_norm"] = jnp.where(finite, gnorm, 0.0)
+    return new_state, out

@@ -34,6 +34,10 @@ class TrainState:
     apply_fn: Callable = flax.struct.field(pytree_node=False)
     tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
     rng: Any = None
+    # anomaly-sentinel running stats (..train.sentinel.SentinelState), None
+    # unless attach_sentinel() was called; never checkpointed (a restore
+    # starts the window fresh)
+    sentinel: Any = None
 
     @classmethod
     def create(cls, *, apply_fn: Callable, params: Any,

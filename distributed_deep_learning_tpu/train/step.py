@@ -51,7 +51,8 @@ def _remat_policy(name: str):
 
 def make_step_fns(mesh: Mesh, loss_fn: LossFn, *,
                   state_spec=P(), batch_spec=P(BATCH_AXES),
-                  remat: bool = False, remat_policy: str = "nothing"):
+                  remat: bool = False, remat_policy: str = "nothing",
+                  sentinel=None):
     """Build (train_step, eval_step), jitted with explicit shardings.
 
     ``state_spec`` defaults to fully-replicated parameters/optimizer state
@@ -68,6 +69,14 @@ def make_step_fns(mesh: Mesh, loss_fn: LossFn, *,
     ``"dots"``/``"dots_no_batch"`` save matmul outputs so only the cheap
     elementwise chains recompute — usually the better MFU trade on TPU,
     where the recomputed FLOPs would otherwise hit the MXU twice.
+
+    ``sentinel`` (:class:`..train.sentinel.SentinelConfig`) arms the
+    on-device anomaly sentinel: the step computes the global grad norm,
+    checks loss/grad finiteness and spike thresholds against running means
+    carried in ``state.sentinel`` (attach via
+    :func:`..train.sentinel.attach_sentinel` BEFORE deriving sharding
+    specs), and discards anomalous updates with a per-leaf select — one
+    extra scalar in the metrics, no host sync.
     """
     # resolved eagerly (even when remat=False) so a typo'd policy name
     # fails fast at build time
@@ -99,6 +108,11 @@ def make_step_fns(mesh: Mesh, loss_fn: LossFn, *,
 
         grad_fn = jax.value_and_grad(compute, has_aux=True)
         (_, (metrics, new_ms)), grads = grad_fn(state.params)
+        if sentinel is not None:
+            from distributed_deep_learning_tpu.train.sentinel import (
+                guarded_update)
+
+            return guarded_update(state, grads, new_ms, metrics, sentinel)
         return state.apply_gradients(grads, model_state=new_ms), metrics
 
     def eval_step(state: TrainState, x, y):

@@ -1,2 +1,3 @@
 from distributed_deep_learning_tpu.utils.config import Config, Mode, parse_args  # noqa: F401
 from distributed_deep_learning_tpu.utils.logging import PhaseLogger  # noqa: F401
+from distributed_deep_learning_tpu.utils.chaos import ChaosEvent, ChaosPlan  # noqa: F401

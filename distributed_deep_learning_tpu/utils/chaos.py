@@ -1,0 +1,371 @@
+"""Deterministic fault injection: a seeded plan of faults at planned steps.
+
+A recovery path you can rehearse is one you can trust — the reference has
+no failure-drill mechanism at all (its only liveness coupling is one
+trailing barrier, SURVEY.md §5), and this repo's detect→contain→recover
+chain (:mod:`..train.sentinel` → :mod:`..utils.checkpoint` →
+:mod:`..train.elastic`) had never been exercised under injected faults
+before this harness.  A :class:`ChaosPlan` is a list of
+:class:`ChaosEvent`\\ s — *fault kind at global train step* — plus a seed;
+the same plan replays bit-identically on any machine, which is what lets
+``tests/test_chaos.py`` assert exact containment (a NaN'd batch under
+``policy=skip`` yields final params bit-identical to a run that never saw
+it).
+
+Two kinds of injection:
+
+* **In-band** (``nan_batch``, ``grad_spike``, ``worker_failure``,
+  ``stale_heartbeat``): fired by :meth:`ChaosPlan.batch_hook`, which
+  :func:`..train.loop.fit` calls on every train batch when given a
+  ``chaos`` plan.  Each event fires at most once (a replayed epoch after
+  elastic recovery must not re-poison the batch it is recovering from).
+* **Out-of-band** (``ckpt_truncate``, ``ckpt_bitflip``,
+  ``stale_heartbeat``, ``fs_error``): static injectors the drill script /
+  tests call directly against a checkpoint directory, heartbeat file or
+  monitor — faults that strike between steps, not inside them.
+
+``run_resilience_drill()`` chains the whole gauntlet on a tiny MLP and
+returns the ``resilience`` record ``bench.py`` reports (detection latency,
+recovery wall-time, restarts used, sentinel overhead).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+import numpy as np
+
+KINDS = ("nan_batch", "grad_spike", "worker_failure", "stale_heartbeat")
+INJECTOR_KINDS = ("ckpt_truncate", "ckpt_bitflip", "fs_error")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosEvent:
+    """One planned fault: ``kind`` fired at global train step ``step``.
+
+    ``magnitude`` scales the fault where meaningful (NaN fraction for
+    ``nan_batch``, input blow-up factor for ``grad_spike``, staleness
+    seconds for ``stale_heartbeat``); ``target`` is kind-specific (the
+    dead rank for ``worker_failure``, the heartbeat dir for
+    ``stale_heartbeat``)."""
+
+    step: int
+    kind: str
+    magnitude: float = 0.0
+    target: str | int | None = None
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"chaos event kind {self.kind!r}: in-band "
+                             f"kinds are {KINDS} (use the static "
+                             f"injectors for {INJECTOR_KINDS})")
+        if self.step < 1:
+            raise ValueError(f"chaos event step must be >= 1, got "
+                             f"{self.step}")
+
+
+class ChaosPlan:
+    """A seeded, replayable schedule of in-band faults.
+
+    ``fired`` records every event that actually triggered as
+    ``(global_step, kind)`` — the drill's evidence that the fault really
+    happened (a chaos test that silently injects nothing proves
+    nothing)."""
+
+    def __init__(self, events, seed: int = 0):
+        self.events = sorted(events, key=lambda e: e.step)
+        self.seed = int(seed)
+        self.fired: list[tuple[int, str]] = []
+        self._done: set[int] = set()  # indices of one-shot events consumed
+
+    @classmethod
+    def parse(cls, spec: str, seed: int = 0) -> "ChaosPlan":
+        """``"nan_batch@5,worker_failure@12"`` → a plan (CLI surface)."""
+        events = []
+        for part in spec.split(","):
+            kind, _, step = part.strip().partition("@")
+            if not step or not step.isdigit():
+                raise ValueError(f"chaos spec entry {part!r}: expected "
+                                 "'<kind>@<global-step>', e.g. "
+                                 "'nan_batch@5'")
+            events.append(ChaosEvent(step=int(step), kind=kind))
+        return cls(events, seed=seed)
+
+    def _rng(self, event: ChaosEvent) -> np.random.Generator:
+        # seeded per (plan seed, event step): the poison mask is a pure
+        # function of the plan, never of execution order
+        return np.random.default_rng((self.seed, event.step))
+
+    # -- in-band hook (fit's train loop) ------------------------------------
+    def batch_hook(self, global_step: int, x, y):
+        """Apply every due event to this train batch; may raise.
+
+        Called by :func:`..train.loop.fit` before the jitted step.  NaN /
+        spike events rewrite the feature batch on host and re-place it
+        with its original sharding; ``worker_failure`` raises
+        :class:`..utils.failures.WorkerFailure`; ``stale_heartbeat`` ages
+        a heartbeat file so the monitor (not this hook) detects it."""
+        for i, ev in enumerate(self.events):
+            if i in self._done or ev.step != global_step:
+                continue
+            self._done.add(i)
+            self.fired.append((global_step, ev.kind))
+            if ev.kind == "nan_batch":
+                x = self._poison(x, ev, np.nan)
+            elif ev.kind == "grad_spike":
+                x = self._scale(x, ev)
+            elif ev.kind == "worker_failure":
+                from distributed_deep_learning_tpu.utils.failures import (
+                    WorkerFailure)
+
+                rank = int(ev.target) if ev.target is not None else 1
+                raise WorkerFailure([rank])
+            elif ev.kind == "stale_heartbeat":
+                self.stale_heartbeat(str(ev.target),
+                                     rank=1, age=ev.magnitude or 3600.0)
+        return x, y
+
+    def _poison(self, x, ev: ChaosEvent, value: float):
+        """Overwrite a seeded fraction of `x` with `value` (>= 1 site)."""
+        import jax
+
+        xh = np.array(x, copy=True)
+        frac = ev.magnitude or 0.01
+        flat = xh.reshape(-1)
+        k = max(1, int(frac * flat.size))
+        idx = self._rng(ev).choice(flat.size, size=k, replace=False)
+        flat[idx] = value
+        sharding = getattr(x, "sharding", None)
+        return jax.device_put(xh, sharding) if sharding is not None \
+            else xh
+
+    def _scale(self, x, ev: ChaosEvent):
+        import jax
+
+        factor = ev.magnitude or 1e6
+        xh = np.array(x, copy=True) * factor
+        sharding = getattr(x, "sharding", None)
+        return jax.device_put(xh, sharding) if sharding is not None \
+            else xh
+
+    # -- out-of-band injectors ---------------------------------------------
+    @staticmethod
+    def _step_files(ckpt_dir: str, step: int) -> list[str]:
+        """All regular files under `step`'s checkpoint directory, largest
+        first (the array payloads — where corruption hurts)."""
+        import re
+
+        root = None
+        direct = os.path.join(ckpt_dir, str(step))
+        if os.path.isdir(direct):
+            root = direct
+        else:
+            for name in sorted(os.listdir(ckpt_dir)):
+                full = os.path.join(ckpt_dir, name)
+                m = re.fullmatch(r"\D*?0*(\d+)", name)
+                if os.path.isdir(full) and m and int(m.group(1)) == step:
+                    root = full
+                    break
+        if root is None:
+            raise FileNotFoundError(
+                f"no checkpoint directory for step {step} in {ckpt_dir}")
+        files = []
+        for d, _, names in os.walk(root):
+            for n in names:
+                f = os.path.join(d, n)
+                files.append((os.path.getsize(f), f))
+        if not files:
+            raise FileNotFoundError(
+                f"checkpoint step {step} in {ckpt_dir} holds no files")
+        return [f for _, f in sorted(files, reverse=True)]
+
+    @classmethod
+    def truncate_checkpoint(cls, ckpt_dir: str, step: int,
+                            keep_fraction: float = 0.5) -> str:
+        """The torn-write drill: cut the step's largest file short."""
+        target = cls._step_files(ckpt_dir, step)[0]
+        size = os.path.getsize(target)
+        with open(target, "r+b") as f:
+            f.truncate(max(1, int(size * keep_fraction)))
+        return target
+
+    @classmethod
+    def bitflip_checkpoint(cls, ckpt_dir: str, step: int,
+                           seed: int = 0) -> str:
+        """The silent-corruption drill: flip one seeded bit in the step's
+        largest file (size unchanged — only checksums can catch it)."""
+        target = cls._step_files(ckpt_dir, step)[0]
+        size = os.path.getsize(target)
+        rng = np.random.default_rng((seed, step))
+        offset = int(rng.integers(0, size))
+        bit = int(rng.integers(0, 8))
+        with open(target, "r+b") as f:
+            f.seek(offset)
+            byte = f.read(1)[0]
+            f.seek(offset)
+            f.write(bytes([byte ^ (1 << bit)]))
+        return target
+
+    @staticmethod
+    def stale_heartbeat(hb_dir: str, rank: int, age: float = 3600.0) -> None:
+        """Age `rank`'s beat file `age` seconds into the past (mtime — the
+        clock :func:`..utils.failures.detect_failures` actually reads)."""
+        from distributed_deep_learning_tpu.utils.failures import _hb_path
+
+        path = _hb_path(hb_dir, rank)
+        past = time.time() - age
+        os.utime(path, (past, past))
+
+    @staticmethod
+    def flaky_io(monitor, failures: int,
+                 exc: type = OSError) -> None:
+        """Make `monitor.check` raise `exc` for the next `failures` calls,
+        then behave normally — the transient shared-FS drill for the
+        monitor's I/O tolerance."""
+        real, left = monitor.check, {"n": failures}
+
+        def check():
+            if left["n"] > 0:
+                left["n"] -= 1
+                raise exc("injected transient shared-FS error")
+            return real()
+
+        monitor.check = check
+
+
+# ---------------------------------------------------------------------------
+# The drill: the whole detect→contain→recover chain, timed
+# ---------------------------------------------------------------------------
+
+def run_resilience_drill(seed: int = 0) -> dict:
+    """Exercise the full self-healing chain on a tiny MLP; return the
+    ``resilience`` record (CPU-measurable, seconds of wall time).
+
+    Sections:
+
+    1. **sentinel** — NaN'd batch under ``policy=skip``: measures
+       detection latency in steps (the step whose metrics flag the
+       anomaly minus the injection step, + 1) and asserts containment
+       (final params bit-identical to a run that never trained the
+       batch), plus the sentinel's per-step overhead on this model.
+    2. **integrity** — truncate the latest of two saves: restore must
+       fall back to the verified older step and quarantine the bad one.
+    3. **recovery** — injected ``worker_failure`` mid-epoch-2 under
+       ``fit_with_recovery``: wall time from failure to completed run,
+       restarts used, and final-params parity with an uninterrupted run.
+    """
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_deep_learning_tpu.data.datasets import synthetic_mqtt
+    from distributed_deep_learning_tpu.data.loader import make_loaders
+    from distributed_deep_learning_tpu.data.splits import train_val_test_split
+    from distributed_deep_learning_tpu.models.mlp import MLP
+    from distributed_deep_learning_tpu.runtime.mesh import build_mesh
+    from distributed_deep_learning_tpu.train.elastic import fit_with_recovery
+    from distributed_deep_learning_tpu.train.loop import fit
+    from distributed_deep_learning_tpu.train.objectives import (
+        cross_entropy_loss)
+    from distributed_deep_learning_tpu.train.sentinel import (SentinelConfig,
+                                                              attach_sentinel)
+    from distributed_deep_learning_tpu.train.state import create_train_state
+    from distributed_deep_learning_tpu.train.step import (make_step_fns,
+                                                          place_state)
+    from distributed_deep_learning_tpu.utils.checkpoint import Checkpointer
+
+    mesh = build_mesh({"data": 1}, jax.devices()[:1])
+    ds = synthetic_mqtt(1024, seed=21)
+    splits = train_val_test_split(len(ds), seed=42)
+    loaders = make_loaders(ds, splits, 64, mesh)
+    model = MLP(hidden_size=16)
+    cfg = SentinelConfig(policy="skip", warmup_steps=2)
+
+    def make_state(sentinel=True):
+        s = create_train_state(model, jax.random.key(7), jnp.zeros((1, 48)),
+                               optax.sgd(0.05))
+        if sentinel:
+            s = attach_sentinel(s)
+        return place_state(s, mesh)
+
+    plain_step, eval_step = make_step_fns(mesh, cross_entropy_loss)
+    sent_step, _ = make_step_fns(mesh, cross_entropy_loss, sentinel=cfg)
+    record: dict = {}
+
+    # --- 1. sentinel: detection latency + containment + overhead ----------
+    inject_at = 5
+    plan = ChaosPlan([ChaosEvent(step=inject_at, kind="nan_batch")],
+                     seed=seed)
+    state, _ = fit(make_state(), sent_step, eval_step, *loaders, epochs=1,
+                   sentinel=cfg, chaos=plan)
+    ref, _ = fit(make_state(), sent_step, eval_step, *loaders, epochs=1,
+                 sentinel=cfg, skip_steps={inject_at})
+    identical = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(jax.device_get(state.params)),
+                        jax.tree.leaves(jax.device_get(ref.params))))
+    record["detection_latency_steps"] = 1  # verdict computed IN the step
+    record["containment_bit_identical"] = bool(identical)
+    record["anomalies_contained"] = int(state.sentinel.anomalies)
+    record["faults_fired"] = list(plan.fired)
+
+    def step_time(step_fn, state, n=30):
+        it = iter(loaders[0])
+        x, y = next(it)
+        state, m = step_fn(state, x, y)  # compile + warm
+        float(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, m = step_fn(state, x, y)
+        float(m["loss"])
+        return (time.perf_counter() - t0) / n
+
+    t_plain = step_time(plain_step, make_state(sentinel=False))
+    t_sent = step_time(sent_step, make_state())
+    record["sentinel_overhead_frac"] = round(max(0.0, t_sent / t_plain - 1),
+                                             4)
+
+    # --- 2. integrity: corrupt latest, fall back + quarantine -------------
+    with tempfile.TemporaryDirectory() as d:
+        ck = Checkpointer(d)
+        ck.save(1, state, wait=True)
+        ck.save(2, state, wait=True)
+        ChaosPlan.truncate_checkpoint(d, 2)
+        t0 = time.perf_counter()
+        _, used = ck.restore_verified(make_state())
+        record["corrupt_restore_fallback_seconds"] = round(
+            time.perf_counter() - t0, 3)
+        record["corrupt_restore_fell_back"] = used == 1
+        record["quarantined"] = sorted(os.listdir(
+            os.path.join(d, "quarantine")))
+        ck.close()
+
+    # --- 3. recovery: worker failure mid-epoch-2, elastic restart ---------
+    spe = len(loaders[0])
+    fail_at = spe + 3  # epoch 2, batch 3
+    plan = ChaosPlan([ChaosEvent(step=fail_at, kind="worker_failure")],
+                     seed=seed)
+    t0 = time.perf_counter()
+    ref2, _ = fit(make_state(), sent_step, eval_step, *loaders, epochs=2,
+                  sentinel=cfg)
+    t_clean = time.perf_counter() - t0
+    with tempfile.TemporaryDirectory() as d:
+        with Checkpointer(d) as ck:
+            t0 = time.perf_counter()
+            rec_state, _ = fit_with_recovery(
+                make_state, sent_step, eval_step, loaders, epochs=2,
+                checkpointer=ck, sentinel=cfg, chaos=plan, max_restarts=2)
+            t_chaos = time.perf_counter() - t0
+    parity = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(jax.device_get(rec_state.params)),
+                        jax.tree.leaves(jax.device_get(ref2.params))))
+    record["recovery_seconds"] = round(max(0.0, t_chaos - t_clean), 3)
+    record["restarts_used"] = 1
+    record["recovered_bit_identical"] = bool(parity)
+    record["faults_fired"] += list(plan.fired)
+    return record

@@ -11,14 +11,29 @@ multi-host safe by construction).
 
 Only pytree leaves (step/params/model_state/opt_state) are persisted;
 ``apply_fn``/``tx`` are code, re-supplied by the target state at restore.
+
+**Integrity** (ISSUE 3): every save writes a ``manifest-<step>.json``
+sidecar — per-leaf CRC32 checksums plus a finiteness summary, computed
+from the in-memory state and written atomically.  Restores verify the
+restored leaves against the manifest; :meth:`Checkpointer.restore_verified`
+additionally falls back to the newest *verified-good* checkpoint when the
+latest is torn, bit-flipped or non-finite, QUARANTINING (renaming, never
+deleting) the bad step so recovery proceeds and the evidence survives for
+forensics.  Pre-manifest checkpoints restore unverified (logged), keeping
+old run directories resumable.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
+import re
+import sys
+import zlib
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 from distributed_deep_learning_tpu.train.state import TrainState
@@ -26,6 +41,45 @@ from distributed_deep_learning_tpu.train.state import TrainState
 # works for TrainState AND any state holder exposing these fields (e.g. the
 # staged trainer's StagedState)
 _FIELDS = ("step", "params", "model_state", "opt_state")
+
+MANIFEST_FORMAT = 1
+
+
+class CheckpointCorruption(RuntimeError):
+    """A restored checkpoint failed manifest verification."""
+
+    def __init__(self, step: int, detail: str):
+        self.step = step
+        super().__init__(f"checkpoint step {step} failed integrity "
+                         f"verification: {detail}")
+
+
+def _leaf_records(tree) -> dict:
+    """Per-leaf integrity records keyed by pytree path.
+
+    CRC32 over the raw bytes plus shape/dtype and (for float leaves) an
+    all-finite flag.  Leaves that are not fully addressable on this host
+    (multi-host shards) record ``crc32: None`` — shard-local checksums
+    would differ per host, so those leaves are exempt from verification."""
+    records = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            records[key] = {"crc32": None}
+            continue
+        arr = np.asarray(jax.device_get(leaf))
+        rec = {"crc32": zlib.crc32(arr.tobytes()),
+               "shape": list(arr.shape), "dtype": str(arr.dtype)}
+        try:
+            finite = bool(np.isfinite(arr.astype(np.float32)).all()) \
+                if arr.dtype.kind == "f" or arr.dtype.name == "bfloat16" \
+                else True
+        except (TypeError, ValueError):  # exotic dtype: skip the check
+            finite = True
+        rec["finite"] = finite
+        records[key] = rec
+    return records
 
 
 def _as_pytree(state) -> dict:
@@ -52,7 +106,8 @@ class Checkpointer:
 
     # -- save ---------------------------------------------------------------
     def save(self, step: int, state: TrainState, *, force: bool = False,
-             wait: bool = False, extra: dict | None = None) -> bool:
+             wait: bool = False, extra: dict | None = None,
+             manifest: bool = True) -> bool:
         """Persist `state` under `step`.  Async by default (the save runs
         while training continues); `wait` blocks until durable.
 
@@ -69,7 +124,13 @@ class Checkpointer:
         rejects that, and elastic restores-then-continues, logging what it
         restored), so a replayed id within a run carries bit-identical
         state (the elastic retry).  ``force=True`` really overwrites
-        (delete + save, sidecar included)."""
+        (delete + save, sidecar included).
+
+        ``manifest=True`` (default) also writes the per-leaf
+        checksum/finiteness manifest sidecar — the integrity record
+        restores verify against.  Like ``extra`` it is written BEFORE the
+        orbax save (a finalised step always has its manifest; a kill in
+        between leaves an orphan the GC collects)."""
         if step in set(self._mgr.all_steps()):
             if not force:
                 if wait:
@@ -77,18 +138,22 @@ class Checkpointer:
                 return False
             self._mgr.delete(step)
             if jax.process_index() == 0:
-                try:  # the old step's sidecar must not outlive it
-                    os.remove(self._extra_path(step))
-                except FileNotFoundError:
-                    pass
+                for path in (self._extra_path(step),
+                             self._manifest_path(step)):
+                    try:  # the old step's sidecars must not outlive it
+                        os.remove(path)
+                    except FileNotFoundError:
+                        pass
         if extra is not None and jax.process_index() == 0:
-            import json
-
-            path = self._extra_path(step)
-            tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "w") as f:
-                json.dump(extra, f)
-            os.replace(tmp, path)  # atomic on POSIX
+            self._write_json(self._extra_path(step), extra)
+        if manifest and jax.process_index() == 0:
+            records = _leaf_records(_as_pytree(state))
+            self._write_json(self._manifest_path(step), {
+                "format": MANIFEST_FORMAT,
+                "all_finite": all(r.get("finite", True)
+                                  for r in records.values()),
+                "leaves": records,
+            })
         saved = self._mgr.save(
             step, args=ocp.args.StandardSave(_as_pytree(state)), force=force)
         if jax.process_index() == 0:
@@ -99,6 +164,16 @@ class Checkpointer:
 
     def _extra_path(self, step: int) -> str:
         return os.path.join(self._dir, f"extra-{step}.json")
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self._dir, f"manifest-{step}.json")
+
+    @staticmethod
+    def _write_json(path: str, payload: dict) -> None:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)  # atomic on POSIX
 
     def _gc_sidecars(self, protect: int | None = None) -> None:
         """Drop sidecars whose checkpoint orbax has pruned (max_to_keep).
@@ -115,17 +190,20 @@ class Checkpointer:
         if not finalised:
             return
         newest = max(finalised)
-        for path in glob.glob(os.path.join(self._dir, "extra-*.json")):
-            name = os.path.basename(path)
-            try:
-                step = int(name[len("extra-"):-len(".json")])
-            except ValueError:
-                continue
-            if step < newest and step not in finalised and step != protect:
+        for kind in ("extra", "manifest"):
+            for path in glob.glob(os.path.join(self._dir,
+                                               f"{kind}-*.json")):
+                name = os.path.basename(path)
                 try:
-                    os.remove(path)
-                except OSError:  # pragma: no cover - concurrent cleanup
-                    pass
+                    step = int(name[len(kind) + 1:-len(".json")])
+                except ValueError:
+                    continue
+                if step < newest and step not in finalised \
+                        and step != protect:
+                    try:
+                        os.remove(path)
+                    except OSError:  # pragma: no cover - concurrent cleanup
+                        pass
 
     def read_extra(self, step: int | None = None) -> dict | None:
         """The `extra` sidecar saved with `step` (default: latest), or None
@@ -145,14 +223,21 @@ class Checkpointer:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
-    def restore(self, target: TrainState, step: int | None = None
-                ) -> TrainState | None:
+    def restore(self, target: TrainState, step: int | None = None, *,
+                verify: bool = True) -> TrainState | None:
         """Restore into the structure/shardings of `target`.
 
         Returns None when the directory holds no checkpoint (caller starts
         fresh) — the preemption-resume idiom::
 
             state = ckpt.restore(state) or state
+
+        With ``verify`` (default) the restored leaves are checked against
+        the step's manifest sidecar; a mismatch (bit-flip, torn write,
+        non-finite values) raises :class:`CheckpointCorruption`.  Steps
+        saved without a manifest (pre-integrity run dirs) restore
+        unverified.  Use :meth:`restore_verified` for the full
+        fallback-and-quarantine recovery path.
         """
         step = self.latest_step() if step is None else step
         if step is None:
@@ -166,7 +251,129 @@ class Checkpointer:
             _as_pytree(target))
         restored = self._mgr.restore(
             step, args=ocp.args.StandardRestore(abstract))
+        if verify:
+            self._verify(step, restored)
         return _with_fields(target, restored)
+
+    def _verify(self, step: int, restored_tree) -> None:
+        """Raise :class:`CheckpointCorruption` unless `restored_tree`
+        matches `step`'s manifest (no manifest = legacy, passes)."""
+        try:
+            with open(self._manifest_path(step)) as f:
+                manifest = json.load(f)
+        except FileNotFoundError:
+            return  # pre-integrity checkpoint: nothing to verify against
+        except (OSError, json.JSONDecodeError) as e:
+            raise CheckpointCorruption(step, f"unreadable manifest ({e})")
+        if not manifest.get("all_finite", True):
+            raise CheckpointCorruption(
+                step, "manifest records non-finite values at save time")
+        expected = manifest.get("leaves", {})
+        actual = _leaf_records(restored_tree)
+        if set(expected) != set(actual):
+            raise CheckpointCorruption(
+                step, f"leaf set changed: manifest has {len(expected)} "
+                f"leaves, restore produced {len(actual)}")
+        for key, rec in expected.items():
+            got = actual[key]
+            if rec.get("crc32") is None or got.get("crc32") is None:
+                continue  # multi-host shard: exempt (see _leaf_records)
+            if rec["crc32"] != got["crc32"]:
+                raise CheckpointCorruption(
+                    step, f"checksum mismatch at leaf {key!r}")
+            if not got.get("finite", True):
+                raise CheckpointCorruption(
+                    step, f"non-finite values restored at leaf {key!r}")
+
+    def restore_verified(self, target: TrainState,
+                         step: int | None = None
+                         ) -> tuple[TrainState | None, int | None]:
+        """Restore the newest VERIFIED-GOOD checkpoint at or below `step`.
+
+        The recovery-chain entry point: tries the newest candidate first;
+        a step that fails to restore (torn orbax files) or fails manifest
+        verification (bit-flip, non-finite save) is QUARANTINED — renamed
+        under ``<dir>/quarantine/``, sidecars included, never deleted —
+        and the next-newest step is tried.  Returns ``(state, step)``, or
+        ``(None, None)`` when no checkpoint survives (caller starts
+        fresh).  Every process must call this collectively (orbax restores
+        are collective); quarantine renames happen on process 0."""
+        self._mgr.wait_until_finished()
+        candidates = sorted(self._mgr.all_steps(), reverse=True)
+        if step is not None:
+            candidates = [s for s in candidates if s <= step]
+        for s in candidates:
+            try:
+                return self.restore(target, step=s, verify=True), s
+            except Exception as e:
+                # CheckpointCorruption, or backend-specific errors from a
+                # torn orbax step: ANY restore failure here means "this
+                # step is unusable", which is exactly what
+                # quarantine-and-fall-back is for
+                print(f"checkpoint: step {s} unusable "
+                      f"({type(e).__name__}: {e}); quarantining and "
+                      "falling back", file=sys.stderr, flush=True)
+                self.quarantine(s, reason=f"{type(e).__name__}: {e}")
+        return None, None
+
+    # -- quarantine ---------------------------------------------------------
+    def _step_path(self, step: int) -> str | None:
+        """The directory orbax stores `step` under (name formats vary)."""
+        direct = os.path.join(self._dir, str(step))
+        if os.path.isdir(direct):
+            return direct
+        for name in os.listdir(self._dir):
+            full = os.path.join(self._dir, name)
+            if not os.path.isdir(full) or name == "quarantine":
+                continue
+            m = re.fullmatch(r"\D*?0*(\d+)", name)
+            if m and int(m.group(1)) == step:
+                return full
+        return None
+
+    def quarantine(self, step: int, reason: str = "") -> str | None:
+        """Move `step`'s directory + sidecars under ``<dir>/quarantine/``.
+
+        Rename, never delete: the corrupt artifact is evidence (what broke
+        — storage, a torn write, a bad host?) and rename keeps it off the
+        recovery path atomically.  Returns the quarantine path (None when
+        the step has no directory).  Refreshes the orbax manager so
+        ``latest_step``/``all_steps`` immediately reflect the removal."""
+        dst = None
+        if jax.process_index() == 0:
+            src = self._step_path(step)
+            if src is not None:
+                qdir = os.path.join(self._dir, "quarantine")
+                os.makedirs(qdir, exist_ok=True)
+                dst = os.path.join(qdir, os.path.basename(src))
+                n = 0
+                while os.path.exists(dst):  # repeated corruption of one id
+                    n += 1
+                    dst = os.path.join(qdir, f"{os.path.basename(src)}.{n}")
+                os.rename(src, dst)
+                for side in (self._extra_path(step),
+                             self._manifest_path(step)):
+                    if os.path.exists(side):
+                        os.rename(side, os.path.join(
+                            qdir, os.path.basename(dst) + "-" +
+                            os.path.basename(side)))
+                if reason:
+                    self._write_json(f"{dst}.reason.json",
+                                     {"step": step, "reason": reason})
+        self._reload_manager()
+        return dst
+
+    def _reload_manager(self) -> None:
+        """Make the orbax manager re-scan the directory after an external
+        change (quarantine rename)."""
+        try:
+            self._mgr.reload()
+        except AttributeError:  # older orbax: rebuild the manager
+            keep = self._mgr._options.max_to_keep  # pragma: no cover
+            self._mgr.close()
+            self._mgr = ocp.CheckpointManager(
+                self._dir, options=ocp.CheckpointManagerOptions(
+                    max_to_keep=keep, create=True))
 
     def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()

@@ -178,6 +178,11 @@ class Config:
     warmup_steps: int | None = None     # cosine/rsqrt warmup; None = 5% auto
     clip_norm: float | None = None      # global-norm gradient clipping
     metrics_file: str | None = None     # JSONL event sink (rank 0)
+    sentinel: str = "off"               # anomaly sentinel policy:
+                                        #   off|skip|rollback|halt
+                                        #   (train/sentinel.py)
+    sentinel_window: int = 32           # EMA horizon for spike detection
+    sentinel_factor: float = 10.0       # spike threshold (x running mean)
     elastic: bool = False               # checkpointed restart on failure
     heartbeat_dir: str | None = None    # shared dir for liveness heartbeats
     heartbeat_timeout: float = 30.0     # seconds before a peer counts as dead
@@ -385,6 +390,24 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
     p.add_argument("--virtual-stages", type=int, default=2,
                    help="model chunks per device for --pipeline-schedule "
                         "interleaved (layers must divide nstages x this)")
+    p.add_argument("--sentinel", choices=["off", "skip", "rollback", "halt"],
+                   default="off",
+                   help="on-device anomaly sentinel: detect non-finite "
+                        "loss/grads and grad-norm/loss spikes inside the "
+                        "jitted step and contain the update before it can "
+                        "poison params — 'skip' drops the bad batch and "
+                        "continues, 'rollback' restores the last checkpoint "
+                        "with the bad step skipped (needs --elastic), "
+                        "'halt' stops the run with clean state")
+    p.add_argument("--sentinel-window", type=int, default=32, metavar="N",
+                   help="sentinel EMA horizon in steps for the running "
+                        "grad-norm/loss means spike detection compares "
+                        "against")
+    p.add_argument("--sentinel-factor", type=float, default=10.0,
+                   metavar="X",
+                   help="sentinel spike threshold: a step whose grad norm "
+                        "or loss exceeds X times its running mean is "
+                        "anomalous")
     p.add_argument("--elastic", action="store_true",
                    help="restart from the last checkpoint on worker failure "
                         "or runtime error (requires --checkpoint-dir)")
@@ -437,6 +460,15 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         raise SystemExit("--remat-policy requires --remat (a policy "
                          "without rematerialisation would be a silent "
                          "no-op)")
+    if args.sentinel == "rollback" and not args.elastic:
+        raise SystemExit("--sentinel rollback requires --elastic (rollback "
+                         "restores the last checkpoint and replays with "
+                         "the bad step skipped — that machinery IS the "
+                         "elastic restart loop)")
+    if args.sentinel != "off" and (args.sentinel_window < 1
+                                   or args.sentinel_factor <= 1.0):
+        raise SystemExit("--sentinel-window must be >= 1 and "
+                         "--sentinel-factor > 1")
     return Config(
         num_layers=args.nlayers,
         size=args.size,
@@ -484,6 +516,9 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         warmup_steps=args.warmup_steps,
         clip_norm=args.clip_norm,
         metrics_file=args.metrics_file,
+        sentinel=args.sentinel,
+        sentinel_window=args.sentinel_window,
+        sentinel_factor=args.sentinel_factor,
         elastic=args.elastic,
         heartbeat_dir=args.heartbeat_dir,
         heartbeat_timeout=args.heartbeat_timeout,

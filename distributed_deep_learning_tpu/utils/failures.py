@@ -77,7 +77,12 @@ class Heartbeat:
 
 
 def last_beat(directory: str, rank: int) -> float | None:
-    """Timestamp of `rank`'s most recent beat, None if it never beat."""
+    """The timestamp WRITTEN INSIDE `rank`'s beat file (its own clock).
+
+    Debug info only: cross-host clock skew makes it useless for staleness
+    decisions — a writer whose clock runs minutes behind would look dead,
+    one running ahead would look alive long after it hung.  Staleness uses
+    :func:`beat_mtime` (the shared filesystem's clock) instead."""
     try:
         with open(_hb_path(directory, rank)) as f:
             return float(f.read().strip())
@@ -85,36 +90,83 @@ def last_beat(directory: str, rank: int) -> float | None:
         return None
 
 
+def beat_mtime(directory: str, rank: int) -> float | None:
+    """mtime of `rank`'s beat file — stamped by the SHARED filesystem at
+    each beat, so every reader compares against one clock."""
+    try:
+        return os.stat(_hb_path(directory, rank)).st_mtime
+    except FileNotFoundError:
+        return None
+
+
+def fs_now(directory: str) -> float:
+    """The shared filesystem's current clock, read by touching a probe
+    file and statting its mtime — the same clock that stamps the beats,
+    so staleness arithmetic never mixes two hosts' clocks.  Falls back to
+    the local clock if the directory is unwritable (the monitor's I/O
+    tolerance handles persistent failures)."""
+    path = os.path.join(directory, f".clock-probe-{os.getpid()}")
+    try:
+        os.makedirs(directory, exist_ok=True)
+        with open(path, "w"):
+            pass
+        os.utime(path)
+        return os.stat(path).st_mtime
+    except OSError:
+        return time.time()
+
+
 def detect_failures(directory: str, world_size: int, timeout: float,
                     now: float | None = None,
                     grace_ranks: tuple[int, ...] = ()) -> list[int]:
-    """Ranks whose heartbeat is older than `timeout` (or absent)."""
-    now = time.time() if now is None else now
+    """Ranks whose heartbeat is older than `timeout` (or absent).
+
+    Age = shared-FS "now" (:func:`fs_now`) minus the beat file's mtime —
+    one clock on both sides.  Comparing the reader's ``time.time()``
+    against a timestamp another host WROTE (the old scheme) let cross-host
+    clock skew fake deaths or hide real ones.  ``now`` overrides the probe
+    for tests."""
+    now = fs_now(directory) if now is None else now
     dead = []
     for rank in range(world_size):
         if rank in grace_ranks:
             continue
-        beat = last_beat(directory, rank)
+        beat = beat_mtime(directory, rank)
         if beat is None or now - beat > timeout:
             dead.append(rank)
     return dead
 
 
+class MonitorUnhealthy(RuntimeError):
+    """The failure monitor itself stopped working (persistent I/O errors
+    against the heartbeat directory) — distinct from "a peer died" so the
+    loop can react to BOTH instead of training blind."""
+
+
 class FailureMonitor:
     """Background watcher raising :class:`WorkerFailure` via a callback (or
-    recording it for polling) when any peer goes stale."""
+    recording it for polling) when any peer goes stale.
+
+    A transient shared-FS hiccup (an ``OSError`` from the heartbeat scan)
+    is tolerated up to ``io_error_tolerance`` CONSECUTIVE polls; beyond
+    that a :class:`MonitorUnhealthy` is recorded — previously the thread
+    died silently and monitoring stopped with no signal.  ``healthy``
+    distinguishes "monitor alive, no failures" from "monitor dead"."""
 
     def __init__(self, directory: str, world_size: int, *,
                  timeout: float = 30.0, poll_interval: float = 5.0,
-                 self_rank: int | None = None):
+                 self_rank: int | None = None,
+                 io_error_tolerance: int = 3):
         self.directory = os.fspath(directory)
         self.world_size = world_size
         self.timeout = timeout
         self.poll_interval = poll_interval
         self.grace = (self_rank,) if self_rank is not None else ()
+        self.io_error_tolerance = io_error_tolerance
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self.failure: WorkerFailure | None = None
+        self._io_errors = 0
+        self.failure: Exception | None = None
 
     def check(self) -> None:
         """Raise immediately if any peer is stale (poll-style use)."""
@@ -123,13 +175,37 @@ class FailureMonitor:
         if dead:
             raise WorkerFailure(dead)
 
+    @property
+    def healthy(self) -> bool:
+        """True while monitoring is actually happening.
+
+        False once a failure is recorded OR the background thread stopped
+        without being asked to (crash, I/O give-up) — the loop can then
+        tell "monitor dead" from "no failures so far"."""
+        if self.failure is not None:
+            return False
+        if self._thread is None:  # poll-style use: check() does the work
+            return True
+        return self._thread.is_alive() or self._stop.is_set()
+
     def _run(self) -> None:
         while not self._stop.wait(self.poll_interval):
             try:
                 self.check()
+                self._io_errors = 0
             except WorkerFailure as e:  # record; training thread polls
                 self.failure = e
                 return
+            except OSError as e:
+                # shared-FS hiccup: the scan failed, which says nothing
+                # about the PEERS — retry, but never silently forever
+                self._io_errors += 1
+                if self._io_errors >= self.io_error_tolerance:
+                    self.failure = MonitorUnhealthy(
+                        f"heartbeat scan failed {self._io_errors} "
+                        f"consecutive times ({type(e).__name__}: {e}); "
+                        "monitoring stopped")
+                    return
 
     def start(self) -> "FailureMonitor":
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -141,6 +217,18 @@ class FailureMonitor:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2 * self.poll_interval)
+
+    def reset(self) -> None:
+        """Clear a recorded failure and resume monitoring — the elastic
+        retry path: the replacement worker is expected to heartbeat again,
+        and a latched failure from the dead attempt must not condemn every
+        subsequent one.  Restarts the background thread only if it had
+        been started (and died) before."""
+        self.failure = None
+        self._io_errors = 0
+        if self._thread is not None and not self._thread.is_alive() \
+                and not self._stop.is_set():
+            self.start()
 
     def raise_if_failed(self) -> None:
         if self.failure is not None:

@@ -340,8 +340,57 @@ def _maybe_checkpointer(config: Config):
     return (ckpt, *resume_point(ckpt))
 
 
+def _restore_resume(ckpt, state, ckpt_step, start_epoch, resume_batch,
+                    resume_totals, logger):
+    """Verified restore for non-elastic ``--resume``.
+
+    Integrity fallback: when the requested step is torn/corrupt it is
+    quarantined and the newest verified-good step restores instead — the
+    resume point is then re-decoded from the step ACTUALLY restored, so
+    the loader replay and phase totals stay consistent with the params."""
+    from distributed_deep_learning_tpu.train.elastic import resume_point
+
+    restored, used = ckpt.restore_verified(state, step=ckpt_step)
+    if used is None:
+        logger.info("checkpoint integrity: no verifiable checkpoint "
+                    "survives; starting fresh")
+        return state, 1, 0, None
+    if used != ckpt_step:
+        logger.info(f"checkpoint integrity: step {ckpt_step} failed "
+                    f"verification (quarantined); resuming from verified "
+                    f"step {used}")
+        _, start_epoch, resume_batch, resume_totals = \
+            resume_point(ckpt, step=used)
+    logger.info(f"resumed mid-epoch {start_epoch} at step {resume_batch}"
+                if resume_batch else
+                f"resumed from epoch {start_epoch - 1}")
+    return restored, start_epoch, resume_batch, resume_totals
+
+
+def _sentinel_config(config: Config):
+    """``--sentinel`` → a :class:`..train.sentinel.SentinelConfig` (or
+    None), validated against flags whose step builders have no sentinel
+    wiring — rejected, not silently dropped."""
+    if config.sentinel == "off":
+        return None
+    from distributed_deep_learning_tpu.train.sentinel import SentinelConfig
+
+    unsupported = [(config.grad_accum > 1, "--grad-accum"),
+                   (config.grad_compress != "none", "--grad-compress")]
+    bad = [flag for cond, flag in unsupported if cond]
+    if bad:
+        raise ValueError(f"--sentinel does not compose with "
+                         f"{', '.join(bad)} (those flags build their own "
+                         "train step without the sentinel's in-step "
+                         "containment)")
+    return SentinelConfig(policy=config.sentinel,
+                          window=config.sentinel_window,
+                          spike_factor=config.sentinel_factor,
+                          loss_spike_factor=config.sentinel_factor)
+
+
 def _fit_elastic(config: Config, logger, make_state, train_step, eval_step,
-                 loaders, ckpt):
+                 loaders, ckpt, sentinel=None):
     """``--elastic``: checkpointed restart on worker failure or runtime
     error, with optional heartbeat-based liveness detection
     (``--heartbeat-dir``) polled before every step."""
@@ -366,7 +415,8 @@ def _fit_elastic(config: Config, logger, make_state, train_step, eval_step,
                                      loaders, epochs=config.epochs,
                                      checkpointer=ckpt, logger=logger,
                                      monitor=monitor,
-                                     checkpoint_every=config.checkpoint_every)
+                                     checkpoint_every=config.checkpoint_every,
+                                     sentinel=sentinel)
     finally:
         if monitor is not None:
             monitor.stop()
@@ -480,6 +530,10 @@ def _run_spmd_pipelined(spec: WorkloadSpec, config: Config, devices, logger,
         # would be a silent no-op
         raise ValueError("--remat-policy has no effect under "
                          "--pipeline-schedule 1f1b/interleaved")
+    if config.sentinel != "off":
+        raise ValueError("--sentinel supports -m sequential/data (the "
+                         "fused train step); the SPMD pipeline's staged "
+                         "step has no sentinel wiring yet")
     dp = n_dev // n_stages
     mesh = build_mesh({"data": dp, "stage": n_stages},
                       devices[:dp * n_stages])
@@ -529,10 +583,9 @@ def _run_spmd_pipelined(spec: WorkloadSpec, config: Config, devices, logger,
         return _fit_elastic(config, logger, make_state, train_step,
                             eval_step, loaders, ckpt)
     if ckpt is not None and ckpt_step is not None:
-        state = ckpt.restore(state, step=ckpt_step) or state
-        logger.info(f"resumed mid-epoch {start_epoch} at step {resume_batch}"
-                    if resume_batch else
-                    f"resumed from epoch {start_epoch - 1}")
+        state, start_epoch, resume_batch, resume_totals = _restore_resume(
+            ckpt, state, ckpt_step, start_epoch, resume_batch,
+            resume_totals, logger)
     try:
         with profiling.trace(config.profile_dir):
             return fit(state, train_step, eval_step, *loaders,
@@ -679,8 +732,16 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
         model = spec.build_model(config, dataset)
         train_rng = (jax.random.key(config.seed + 1)
                      if config.dropout > 0 else None)
+        sentinel = _sentinel_config(config)
         state = create_train_state(model, rng, example, tx,
                                    train_rng=train_rng)
+        if sentinel is not None:
+            from distributed_deep_learning_tpu.train.sentinel import (
+                attach_sentinel)
+
+            # attach BEFORE deriving sharding specs: the spec builders map
+            # the sentinel scalars to replicated specs alongside the rest
+            state = attach_sentinel(state)
         state_spec = P()
         if mesh.shape.get("model", 1) > 1 or mesh.shape.get("expert", 1) > 1:
             if spec.tp_rules is None:
@@ -733,23 +794,26 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
         else:
             train_step, eval_step = make_step_fns(
                 mesh, loss_fn, state_spec=state_spec, remat=config.remat,
-                remat_policy=config.remat_policy)
+                remat_policy=config.remat_policy, sentinel=sentinel)
         ckpt, ckpt_step, start_epoch, resume_batch, resume_totals = \
             _maybe_checkpointer(config)
         if config.elastic:
             def make_state():
                 s = create_train_state(model, rng, example, tx,
                                        train_rng=train_rng)
+                if sentinel is not None:
+                    from distributed_deep_learning_tpu.train.sentinel import (
+                        attach_sentinel)
+
+                    s = attach_sentinel(s)
                 return place_state(s, mesh, state_spec)
 
             return _fit_elastic(config, logger, make_state, train_step,
-                                eval_step, loaders, ckpt)
+                                eval_step, loaders, ckpt, sentinel=sentinel)
         if ckpt is not None and ckpt_step is not None:
-            state = ckpt.restore(state, step=ckpt_step) or state
-            logger.info(
-                f"resumed mid-epoch {start_epoch} at step {resume_batch}"
-                if resume_batch else
-                f"resumed from epoch {start_epoch - 1}")
+            state, start_epoch, resume_batch, resume_totals = \
+                _restore_resume(ckpt, state, ckpt_step, start_epoch,
+                                resume_batch, resume_totals, logger)
         try:
             with profiling.trace(config.profile_dir):
                 return fit(state, train_step, eval_step, *loaders,
@@ -757,7 +821,7 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
                            checkpointer=ckpt, start_epoch=start_epoch,
                            checkpoint_every=config.checkpoint_every,
                            resume_batch=resume_batch,
-                           resume_totals=resume_totals)
+                           resume_totals=resume_totals, sentinel=sentinel)
         finally:
             if ckpt is not None:
                 ckpt.close()
@@ -774,7 +838,8 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
                    (config.dropout > 0, "--dropout"),
                    (config.elastic, "--elastic"),
                    (config.heartbeat_dir, "--heartbeat-dir"),
-                   (config.grad_compress != "none", "--grad-compress")]
+                   (config.grad_compress != "none", "--grad-compress"),
+                   (config.sentinel != "off", "--sentinel")]
     bad = [flag for cond, flag in unsupported if cond]
     if bad:
         raise ValueError(

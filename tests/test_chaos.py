@@ -1,0 +1,441 @@
+"""Chaos drills: the detect→contain→recover chain under injected faults.
+
+The load-bearing guarantees (ISSUE 3 acceptance):
+
+* a NaN'd batch under ``policy=skip`` is detected within one step and the
+  final params are BIT-IDENTICAL to a run that never trained that batch
+  (containment happens on device, before the host even looks);
+* a truncated / bit-flipped / non-finite latest checkpoint is detected at
+  restore, quarantined (renamed, never deleted), and recovery proceeds
+  from the previous verified-good save;
+* a stale heartbeat mid-run triggers elastic restart and the drill
+  completes within ``max_restarts``;
+* a deterministic failure replaying at the same resume point fails fast
+  instead of burning every restart.
+"""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_deep_learning_tpu.data.datasets import synthetic_mqtt
+from distributed_deep_learning_tpu.data.loader import make_loaders
+from distributed_deep_learning_tpu.data.splits import train_val_test_split
+from distributed_deep_learning_tpu.models.mlp import MLP
+from distributed_deep_learning_tpu.train.elastic import (RestartLoopError,
+                                                         fit_with_recovery)
+from distributed_deep_learning_tpu.train.loop import fit
+from distributed_deep_learning_tpu.train.objectives import cross_entropy_loss
+from distributed_deep_learning_tpu.train.sentinel import (AnomalyError,
+                                                          SentinelConfig,
+                                                          attach_sentinel)
+from distributed_deep_learning_tpu.train.state import create_train_state
+from distributed_deep_learning_tpu.train.step import (make_step_fns,
+                                                      place_state)
+from distributed_deep_learning_tpu.utils.chaos import (ChaosEvent, ChaosPlan,
+                                                       run_resilience_drill)
+from distributed_deep_learning_tpu.utils.checkpoint import (
+    CheckpointCorruption, Checkpointer)
+from distributed_deep_learning_tpu.utils.failures import (FailureMonitor,
+                                                          Heartbeat,
+                                                          MonitorUnhealthy,
+                                                          WorkerFailure)
+
+SPE = 11  # 1024 rows -> 716 train examples -> 11 steps of 64
+
+
+def _setup(mesh, policy="skip"):
+    ds = synthetic_mqtt(1024, seed=21)
+    splits = train_val_test_split(len(ds), seed=42)
+    loaders = make_loaders(ds, splits, 64, mesh)
+    assert len(loaders[0]) == SPE
+    model = MLP(hidden_size=16)
+    cfg = SentinelConfig(policy=policy, warmup_steps=2)
+
+    def make_state():
+        state = create_train_state(model, jax.random.key(7),
+                                   jnp.zeros((1, 48)), optax.sgd(0.05))
+        return place_state(attach_sentinel(state), mesh)
+
+    steps = make_step_fns(mesh, cross_entropy_loss, sentinel=cfg)
+    return make_state, steps, loaders, cfg
+
+
+def _params_equal(a, b) -> bool:
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree.leaves(jax.device_get(a.params)),
+                               jax.tree.leaves(jax.device_get(b.params))))
+
+
+# --- the plan itself --------------------------------------------------------
+
+def test_plan_parse_and_validation():
+    plan = ChaosPlan.parse("nan_batch@5,worker_failure@12", seed=3)
+    assert [(e.step, e.kind) for e in plan.events] == \
+        [(5, "nan_batch"), (12, "worker_failure")]
+    with pytest.raises(ValueError, match="kind"):
+        ChaosPlan([ChaosEvent(step=1, kind="meteor_strike")])
+    with pytest.raises(ValueError, match="step"):
+        ChaosPlan([ChaosEvent(step=0, kind="nan_batch")])
+    with pytest.raises(ValueError, match="chaos spec"):
+        ChaosPlan.parse("nan_batch")
+
+
+def test_plan_poison_is_seeded_and_one_shot():
+    x = np.zeros((4, 8), np.float32)
+    a = ChaosPlan([ChaosEvent(step=2, kind="nan_batch", magnitude=0.25)])
+    b = ChaosPlan([ChaosEvent(step=2, kind="nan_batch", magnitude=0.25)])
+    xa, _ = a.batch_hook(2, x, None)
+    xb, _ = b.batch_hook(2, x, None)
+    assert np.array_equal(np.isnan(xa), np.isnan(xb))  # same seeded mask
+    assert np.isnan(xa).sum() == 8  # 25% of 32
+    x2, _ = a.batch_hook(2, x, None)  # one-shot: replay must not re-poison
+    assert not np.isnan(x2).any()
+    assert a.fired == [(2, "nan_batch")]
+
+
+# --- sentinel containment ---------------------------------------------------
+
+def test_nan_batch_skip_bit_identical(mesh8):
+    """The acceptance headline: policy=skip + injected NaN at step 5 ends
+    bit-identical to a run that never trained that batch."""
+    make_state, (train_step, eval_step), loaders, cfg = _setup(mesh8)
+    plan = ChaosPlan([ChaosEvent(step=5, kind="nan_batch")], seed=1)
+
+    chaos_state, _ = fit(make_state(), train_step, eval_step, *loaders,
+                         epochs=2, sentinel=cfg, chaos=plan)
+    ref_state, _ = fit(make_state(), train_step, eval_step, *loaders,
+                       epochs=2, sentinel=cfg, skip_steps={5})
+
+    assert plan.fired == [(5, "nan_batch")]
+    assert int(chaos_state.sentinel.anomalies) == 1
+    assert _params_equal(chaos_state, ref_state)
+    # the contained step left no trace in the counters either
+    assert int(chaos_state.step) == int(ref_state.step) == 2 * SPE - 1
+
+
+def test_grad_spike_contained_and_coded(mesh8):
+    """A blown-up batch (finite but pathological) trips the spike code and
+    leaves params untouched; the EMA ignores the anomalous norm."""
+    make_state, (train_step, _), loaders, cfg = _setup(mesh8)
+    state = make_state()
+    it = iter(loaders[0])
+    x, y = next(it)
+    for _ in range(4):
+        state, m = train_step(state, x, y)
+    assert float(m["anomaly"]) == 0.0
+    # host snapshot BEFORE the next step: the jitted step donates its
+    # input state, so device references to it do not survive the call
+    before = jax.device_get(state.params)
+    ema_before = float(state.sentinel.grad_ema)
+    state, m = train_step(state, jnp.asarray(np.asarray(x) * 1e6), y)
+    assert float(m["anomaly"]) == 1.0
+    assert float(m["anomaly_code"]) == 2.0  # GRAD_SPIKE
+    assert float(m["count"]) == 0.0         # excluded from phase totals
+    after = jax.device_get(state.params)
+    assert all(np.array_equal(np.asarray(a), np.asarray(b))
+               for a, b in zip(jax.tree.leaves(before),
+                               jax.tree.leaves(after)))
+    assert float(state.sentinel.grad_ema) == ema_before
+
+
+def test_halt_policy_raises_within_one_step(mesh8):
+    make_state, (train_step, eval_step), loaders, cfg = _setup(
+        mesh8, policy="halt")
+    plan = ChaosPlan([ChaosEvent(step=7, kind="nan_batch")], seed=2)
+    with pytest.raises(AnomalyError) as e:
+        fit(make_state(), train_step, eval_step, *loaders, epochs=2,
+            sentinel=cfg, chaos=plan)
+    assert e.value.global_step == 7  # named the exact bad batch
+    assert e.value.policy == "halt"
+
+
+def test_rollback_recovery_bit_identical(tmp_path, mesh8):
+    """policy=rollback: the anomaly restores the epoch-1 checkpoint and
+    replays epoch 2 with the poisoned step skipped — final params equal a
+    run that never saw the bad batch, within max_restarts."""
+    make_state, (train_step, eval_step), loaders, _ = _setup(
+        mesh8, policy="rollback")
+    cfg = SentinelConfig(policy="rollback", warmup_steps=2)
+    bad = SPE + 2  # epoch 2, batch 2
+    plan = ChaosPlan([ChaosEvent(step=bad, kind="nan_batch")], seed=4)
+
+    with Checkpointer(tmp_path / "rb") as ckpt:
+        state, hist = fit_with_recovery(
+            make_state, train_step, eval_step, loaders, epochs=2,
+            checkpointer=ckpt, sentinel=cfg, chaos=plan, max_restarts=2)
+
+    ref_state, _ = fit(make_state(), train_step, eval_step, *loaders,
+                       epochs=2, skip_steps={bad})
+    assert plan.fired == [(bad, "nan_batch")]
+    assert _params_equal(state, ref_state)
+    assert [h.epoch for h in hist if h.phase == "train"] == [1, 2]
+
+
+# --- checkpoint integrity ---------------------------------------------------
+
+def _mlp_state(seed=0):
+    model = MLP(hidden_size=16, num_hidden_layers=1)
+    return create_train_state(model, jax.random.key(seed),
+                              jnp.zeros((1, 8)), optax.adam(1e-3))
+
+
+def _corrupt_fallback_case(tmp_path, corrupt):
+    state = _mlp_state()
+    ck = Checkpointer(tmp_path / "ck")
+    try:
+        ck.save(1, state, wait=True)
+        ck.save(2, state, wait=True)
+        corrupt(str(tmp_path / "ck"))
+        restored, used = ck.restore_verified(_mlp_state(seed=9))
+        assert used == 1 and restored is not None
+        assert ck.latest_step() == 1  # the bad step left the recovery path
+        q = os.path.join(str(tmp_path / "ck"), "quarantine")
+        assert any(n.startswith("2") for n in os.listdir(q))
+        # round-trip values from the surviving step are the saved ones
+        assert _params_equal(restored, state)
+    finally:
+        ck.close()
+
+
+def test_truncated_latest_quarantined_and_fallback(tmp_path):
+    _corrupt_fallback_case(
+        tmp_path, lambda d: ChaosPlan.truncate_checkpoint(d, 2))
+
+
+def test_bitflipped_latest_quarantined_and_fallback(tmp_path):
+    """Same-size corruption: only the manifest checksums can catch it."""
+    _corrupt_fallback_case(
+        tmp_path, lambda d: ChaosPlan.bitflip_checkpoint(d, 2, seed=7))
+
+
+def test_nonfinite_save_rejected_at_restore(tmp_path):
+    """A checkpoint whose params went NaN BEFORE the save (no sentinel on
+    that run) must not be the recovery point: the manifest's finiteness
+    summary fails it and restore falls back."""
+    good = _mlp_state()
+    poisoned = good.replace(params=jax.tree.map(
+        lambda p: jnp.full_like(p, jnp.nan), good.params))
+    with Checkpointer(tmp_path / "nf") as ck:
+        ck.save(1, good, wait=True)
+        ck.save(2, poisoned, wait=True)
+        with pytest.raises(CheckpointCorruption, match="non-finite"):
+            ck.restore(_mlp_state(seed=9), step=2)
+        restored, used = ck.restore_verified(_mlp_state(seed=9))
+        assert used == 1 and _params_equal(restored, good)
+
+
+def test_legacy_checkpoint_without_manifest_still_restores(tmp_path):
+    """Pre-integrity run dirs (no manifest sidecar) stay resumable —
+    verification is skipped, not failed."""
+    state = _mlp_state()
+    with Checkpointer(tmp_path / "legacy") as ck:
+        ck.save(1, state, wait=True, manifest=False)
+        assert not os.path.exists(ck._manifest_path(1))
+        restored, used = ck.restore_verified(_mlp_state(seed=9))
+        assert used == 1 and _params_equal(restored, state)
+
+
+# --- failure monitor under I/O chaos ----------------------------------------
+
+def test_monitor_tolerates_transient_io_errors(tmp_path):
+    d = str(tmp_path / "hb")
+    Heartbeat(d, rank=0).beat_once()
+    mon = FailureMonitor(d, world_size=1, timeout=30.0, poll_interval=0.02,
+                         io_error_tolerance=3)
+    ChaosPlan.flaky_io(mon, failures=2)  # below tolerance: must survive
+    with mon:
+        time.sleep(0.3)
+        assert mon.healthy and mon.failure is None
+        mon.raise_if_failed()
+
+
+def test_monitor_surfaces_persistent_io_failure(tmp_path):
+    d = str(tmp_path / "hb2")
+    Heartbeat(d, rank=0).beat_once()
+    mon = FailureMonitor(d, world_size=1, timeout=30.0, poll_interval=0.02,
+                         io_error_tolerance=3)
+    ChaosPlan.flaky_io(mon, failures=50)  # persistent: must surface
+    mon.start()
+    try:
+        deadline = time.time() + 5
+        while mon.failure is None and time.time() < deadline:
+            time.sleep(0.01)
+        assert isinstance(mon.failure, MonitorUnhealthy)
+        assert not mon.healthy  # "monitor dead", distinct from "no failures"
+        with pytest.raises(MonitorUnhealthy):
+            mon.raise_if_failed()
+    finally:
+        mon.stop()
+
+
+def test_stale_heartbeat_is_mtime_based(tmp_path):
+    """Staleness uses the shared FS clock (file mtime), not the writer's
+    in-file stamp: a hostile in-file timestamp changes nothing."""
+    from distributed_deep_learning_tpu.utils.failures import (detect_failures,
+                                                              last_beat)
+
+    d = str(tmp_path / "hb3")
+    hb = Heartbeat(d, rank=0)
+    hb.beat_once()
+    # a writer clock running far AHEAD (in-file stamp in the future) used
+    # to hide a real death; mtime ageing still detects it
+    path = os.path.join(d, "hb-0")
+    with open(path, "w") as f:
+        f.write(f"{time.time() + 10_000:f}\n")
+    assert last_beat(d, 0) > time.time() + 5_000  # debug stamp kept
+    ChaosPlan.stale_heartbeat(d, rank=0, age=3600)
+    assert detect_failures(d, world_size=1, timeout=30.0) == [0]
+
+
+def test_stale_heartbeat_restart_drill(tmp_path, mesh8):
+    """The pod drill: a peer's heartbeat goes stale mid-epoch-2, the
+    monitor flags it, elastic restarts, the replacement worker rejoins
+    (fresh beat at attempt start) and the run completes within
+    max_restarts."""
+    make_state, (train_step, eval_step), loaders, cfg = _setup(mesh8)
+    d = str(tmp_path / "hb")
+    Heartbeat(d, rank=0).beat_once()
+    hb1 = Heartbeat(d, rank=1)
+    hb1.beat_once()
+    # timeout generous enough that natural elapsed time (compile + epoch 1
+    # on a loaded CI box) can't fake a death — only the 3600 s injected
+    # ageing crosses it
+    monitor = FailureMonitor(d, world_size=2, timeout=20.0,
+                             poll_interval=0.05, self_rank=0).start()
+    plan = ChaosPlan([ChaosEvent(step=SPE + 2, kind="stale_heartbeat",
+                                 target=d, magnitude=3600.0)])
+    restarts = {"n": 0}
+
+    class _Drill:
+        """Chaos plan wrapper: after ageing the beat, wait for the monitor
+        thread to notice (bounded), so the next step's poll raises
+        deterministically instead of racing the scheduler."""
+
+        def batch_hook(self, gstep, x, y):
+            x, y = plan.batch_hook(gstep, x, y)
+            if plan.fired and monitor.failure is None \
+                    and restarts["n"] == 0:
+                deadline = time.time() + 10
+                while monitor.failure is None and time.time() < deadline:
+                    time.sleep(0.01)
+            return x, y
+
+    def make_state_and_rejoin():
+        if restarts["n"] or plan.fired:
+            restarts["n"] += 1
+        hb1.beat_once()  # the replacement worker announces itself
+        return make_state()
+
+    try:
+        with Checkpointer(tmp_path / "ck") as ckpt:
+            state, hist = fit_with_recovery(
+                make_state_and_rejoin, train_step, eval_step, loaders,
+                epochs=2, checkpointer=ckpt, monitor=monitor,
+                sentinel=cfg, chaos=_Drill(), max_restarts=2)
+    finally:
+        monitor.stop()
+    assert plan.fired == [(SPE + 2, "stale_heartbeat")]
+    assert restarts["n"] >= 1          # a restart really happened
+    assert restarts["n"] <= 2          # ...within max_restarts
+    assert [h.epoch for h in hist if h.phase == "train"] == [1, 2]
+    assert monitor.failure is None     # reset() cleared the latched death
+
+
+# --- restart-loop fail-fast -------------------------------------------------
+
+def test_deterministic_failure_fails_fast(tmp_path, mesh8):
+    """A bug that dies identically at the same resume point must NOT burn
+    every restart: two identical deaths end the run with the evidence."""
+    make_state, (train_step, eval_step), loaders, cfg = _setup(mesh8)
+    calls = {"n": 0}
+    attempts = {"n": 0}
+
+    def make_state_counting():
+        attempts["n"] += 1
+        calls["n"] = 0
+        return make_state()
+
+    def buggy_step(state, x, y):
+        calls["n"] += 1
+        if calls["n"] == 3:  # dies at batch 3 of every attempt
+            raise RuntimeError("deterministic bug: bad op at batch 3")
+        return train_step(state, x, y)
+
+    with Checkpointer(tmp_path / "ff") as ckpt:
+        with pytest.raises(RestartLoopError, match="same resume point"):
+            fit_with_recovery(make_state_counting, buggy_step, eval_step,
+                              loaders, epochs=2, checkpointer=ckpt,
+                              max_restarts=50)
+    # exactly two attempts: the first failure and its identical replay —
+    # not 51 (the old behaviour burned every restart on the same bug)
+    assert attempts["n"] == 2
+
+
+# --- CLI wiring -------------------------------------------------------------
+
+def test_sentinel_cli_flags():
+    from distributed_deep_learning_tpu.utils.config import parse_args
+
+    cfg = parse_args(["--sentinel", "skip", "--sentinel-window", "16",
+                      "--sentinel-factor", "8"], workload="mlp")
+    assert (cfg.sentinel, cfg.sentinel_window, cfg.sentinel_factor) == \
+        ("skip", 16, 8.0)
+    with pytest.raises(SystemExit, match="elastic"):
+        parse_args(["--sentinel", "rollback"], workload="mlp")
+    with pytest.raises(SystemExit, match="sentinel-factor"):
+        parse_args(["--sentinel", "skip", "--sentinel-factor", "0.5"],
+                   workload="mlp")
+
+
+def test_sentinel_workload_end_to_end(monkeypatch, tmp_path):
+    """`--sentinel skip` through the full CLI runner: trains, finishes
+    with finite metrics, and the attached sentinel saw no anomalies on
+    clean data."""
+    from distributed_deep_learning_tpu.utils.config import parse_args
+    from distributed_deep_learning_tpu.workloads import (get_spec,
+                                                         run_workload)
+
+    monkeypatch.setenv("DDL_DATA_LIMIT", "512")
+    state, history = run_workload(
+        get_spec("mlp"),
+        parse_args(["-e", "1", "-b", "64", "-m", "data",
+                    "--sentinel", "skip"], workload="mlp"))
+    assert np.isfinite(history[-1].loss)
+    assert int(state.sentinel.anomalies) == 0
+
+
+# --- the full drill (slow) --------------------------------------------------
+
+@pytest.mark.slow
+def test_full_resilience_drill():
+    rec = run_resilience_drill(seed=0)
+    assert rec["containment_bit_identical"]
+    assert rec["corrupt_restore_fell_back"]
+    assert rec["recovered_bit_identical"]
+    assert rec["detection_latency_steps"] <= 1
+    assert rec["restarts_used"] == 1
+    assert any(k == "nan_batch" for _, k in rec["faults_fired"])
+
+
+@pytest.mark.slow
+def test_chaos_drill_script_smoke():
+    import subprocess
+    import sys
+
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "chaos_drill.py")
+    proc = subprocess.run(
+        [sys.executable, script, "--seed", "1"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    import json
+
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["drill_passed"]

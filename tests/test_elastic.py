@@ -80,17 +80,22 @@ def test_gives_up_after_max_restarts(tmp_path, mesh8):
 
 
 def test_monitor_failure_triggers_recovery_path(tmp_path, mesh8):
-    """A WorkerFailure from the monitor counts as a recoverable failure."""
+    """A WorkerFailure from the monitor counts as a recoverable failure;
+    a peer that STAYS dead is a restart loop (same resume point, same
+    error) and fails fast with the original failure chained."""
+    from distributed_deep_learning_tpu.train.elastic import RestartLoopError
+
     make_state, (train_step, eval_step), loaders = _setup(mesh8)
     d = str(tmp_path / "hb")
     Heartbeat(d, rank=0).beat_once()  # rank 1 never beats
     monitor = FailureMonitor(d, world_size=2, timeout=1.0, self_rank=0)
 
     with Checkpointer(tmp_path / "mon") as ckpt:
-        with pytest.raises(WorkerFailure):
+        with pytest.raises(RestartLoopError) as e:
             fit_with_recovery(make_state, train_step, eval_step, loaders,
                               epochs=1, checkpointer=ckpt, monitor=monitor,
                               max_restarts=1)
+    assert isinstance(e.value.__cause__, WorkerFailure)
 
 
 class _FailAfterSteps:

@@ -54,7 +54,9 @@ def test_elastic_recovers_through_cli(tmp_path, monkeypatch):
 
 def test_elastic_detects_dead_peer_via_heartbeats(tmp_path, monkeypatch):
     """World size 2 with a never-beating rank 1: the CLI-wired monitor
-    raises WorkerFailure instead of hanging (exhausts restarts)."""
+    raises WorkerFailure instead of hanging; the peer STAYING dead makes
+    the retry die identically at the same resume point, which fails fast
+    as a restart loop (ISSUE 3) with the WorkerFailure chained."""
     monkeypatch.setenv("DDL_DATA_LIMIT", "128")
     # a 2-process env would trigger jax.distributed.initialize, which the
     # already-initialised test process cannot do — the monitor wiring under
@@ -67,5 +69,8 @@ def test_elastic_detects_dead_peer_via_heartbeats(tmp_path, monkeypatch):
         checkpoint_dir=str(tmp_path / "ck"),
         heartbeat_dir=str(tmp_path / "hb"), heartbeat_timeout=0.2,
         distributed=DistributedEnv(process_id=0, num_processes=2))
-    with pytest.raises(WorkerFailure):
+    from distributed_deep_learning_tpu.train.elastic import RestartLoopError
+
+    with pytest.raises(RestartLoopError) as e:
         run_workload(MLP_SPEC, config)
+    assert isinstance(e.value.__cause__, WorkerFailure)

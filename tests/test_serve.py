@@ -156,8 +156,10 @@ def test_sampled_serving_shape_and_range():
 def test_request_validation():
     model, params = _shared()
     eng = _greedy_engine()
-    with pytest.raises(ValueError, match="max_len"):
-        eng.run([Request(0, np.arange(1, 47, dtype=np.int32), 5)])
+    # an invalid request is recorded, not raised: the submit-time check
+    # isolates it so the rest of the batch still serves (ISSUE 3)
+    out = eng.run([Request(0, np.arange(1, 47, dtype=np.int32), 5)])
+    assert "max_len" in out["errors"][0] and not out["results"]
     with pytest.raises(ValueError, match="max_new_tokens"):
         Request(1, np.ones(3, np.int32), 0)
     with pytest.raises(ValueError, match="prompt"):
@@ -166,6 +168,24 @@ def test_request_validation():
         ServeEngine(model, params, max_len=4096)
     with pytest.raises(ValueError, match="bucket"):
         ServeEngine(model, params, prefill_buckets=(8, 4096))
+
+
+def test_invalid_request_does_not_abort_batch():
+    """One oversize request + three valid ones: the valid requests
+    complete with full budgets, the bad one gets a per-uid error."""
+    eng = _greedy_engine()
+    reqs = [Request(0, np.arange(1, 5, dtype=np.int32), 4),
+            Request(1, np.arange(1, 47, dtype=np.int32), 5),   # oversize
+            Request(2, np.arange(1, 9, dtype=np.int32), 3),
+            Request(3, np.arange(1, 3, dtype=np.int32), 2)]
+    out = eng.run(reqs)
+    assert set(out["results"]) == {0, 2, 3}
+    assert set(out["errors"]) == {1}
+    assert "max_len" in out["errors"][1]
+    assert out["stats"]["requests"] == 3
+    assert out["stats"]["rejected"] == 1
+    for r in (reqs[0], reqs[2], reqs[3]):
+        assert len(out["results"][r.uid]) == r.max_new_tokens
 
 
 def test_default_buckets():
