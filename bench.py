@@ -437,6 +437,50 @@ def _resilience() -> dict | None:
     return {"metric": "self-healing drill (chaos-injected)", **rec}
 
 
+def _autotune() -> dict | None:
+    """Auto-parallelism planner (ISSUE 5): search the plan lattice for the
+    MLP workload on this box's devices and report best-vs-default measured
+    step time — CPU-measurable (the trials compile and run the real train
+    step).  The chosen ``plan_hash`` is recorded so BENCH_*.json tracks
+    plan churn across commits; the search space here is the cheap
+    (mesh x remat) slice sized for the bench budget."""
+    from distributed_deep_learning_tpu.tune.search import run_search
+    from distributed_deep_learning_tpu.utils.config import parse_args
+    from distributed_deep_learning_tpu.workloads import get_spec
+
+    batch = int(os.environ.get("BENCH_AUTOTUNE_BATCH", 32))
+    trials = int(os.environ.get("BENCH_AUTOTUNE_TRIALS", 6))
+    spec = get_spec("mlp")
+    config = parse_args(["-e", "1", "-b", str(batch), "-m", "data"],
+                        workload="mlp")
+    result = run_search(
+        spec, config, trial_steps=2, max_trials=trials,
+        space_options=dict(zero_options=("none", "fsdp"),
+                           compress_options=("none",),
+                           grad_accum_options=(1,)))
+    from distributed_deep_learning_tpu.tune.artifact import plan_hash
+
+    best_ms = 1e3 / result.best_sps if result.best_sps else None
+    base_ms = 1e3 / result.baseline_sps if result.baseline_sps else None
+    return {
+        "metric": "autotuned plan vs hand default (mlp train step)",
+        "plan_hash": plan_hash(result.best),
+        "plan": result.best.describe(),
+        "best_steps_per_sec": round(result.best_sps, 2),
+        "best_examples_per_sec": round(result.best_sps * batch, 1),
+        "baseline_steps_per_sec": round(result.baseline_sps, 2),
+        "best_step_ms": round(best_ms, 3) if best_ms else None,
+        "baseline_step_ms": round(base_ms, 3) if base_ms else None,
+        "speedup": round(result.best_sps / result.baseline_sps, 4)
+            if result.baseline_sps else None,
+        "n_candidates": result.n_candidates,
+        "n_pruned_analytic": result.n_pruned,
+        "n_infeasible": result.n_infeasible,
+        "rungs": result.rungs,
+        "search_seconds": round(result.search_seconds, 2),
+    }
+
+
 def _attention_speedup(steps: int = 20) -> float | None:
     """Fused (Pallas flash) vs dense attention fwd+bwd at a long-context
     shape; returns flash/dense step-time ratio > 1 = flash faster.  TPU
@@ -744,6 +788,25 @@ def main() -> None:
             print(f"bench: resilience section failed "
                   f"({type(exc).__name__}: {exc})", file=sys.stderr)
 
+    # --- autotune: planner search vs hand default ---------------------------
+    autotune = None
+    t_tune = 120 if on_tpu else 60
+    if os.environ.get("BENCH_AUTOTUNE", "1") != "0" and \
+            _time_left() < t_tune:
+        print(f"bench: shedding autotune section ({_time_left():.0f}s left)",
+              file=sys.stderr)
+    elif os.environ.get("BENCH_AUTOTUNE", "1") != "0":
+        try:
+            with _section_timer("autotune"):
+                autotune = _autotune()
+            avs = _vs_baseline(baselines,
+                               f"{platform}:autotune_mlp_steps_per_sec_v1",
+                               autotune["best_steps_per_sec"], base_path)
+            autotune["vs_baseline"] = round(avs, 4)
+        except Exception as exc:
+            print(f"bench: autotune section failed "
+                  f"({type(exc).__name__}: {exc})", file=sys.stderr)
+
     attn_speedup = None
     if on_tpu and os.environ.get("BENCH_ATTENTION", "1") != "0":
         if _time_left() < 90:
@@ -774,6 +837,7 @@ def main() -> None:
         "input_pipeline": input_pipe,
         "serving": serving,
         "resilience": resilience,
+        "autotune": autotune,
         "flash_attention_speedup":
             round(attn_speedup, 3) if attn_speedup else None,
         "section_secs": section_secs,

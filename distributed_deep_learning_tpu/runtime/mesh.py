@@ -32,12 +32,15 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-# Canonical axis order.  `data` outermost (DCN-friendly: gradient all-reduce
-# tolerates lower bandwidth), then fsdp (ZeRO-style param shard), then stage
-# (pipeline), then model (tensor), then seq (context/ring-attention), then
-# expert (MoE).  Order matters: ICI neighbours should serve the
-# bandwidth-hungry inner axes.
-AXES = ("data", "fsdp", "stage", "model", "seq", "expert")
+from distributed_deep_learning_tpu.utils.config import MESH_AXES
+
+# Canonical axis order (defined jax-free in utils/config.py so the CLI can
+# validate --mesh at parse time).  `data` outermost (DCN-friendly: gradient
+# all-reduce tolerates lower bandwidth), then fsdp (ZeRO-style param shard),
+# then stage (pipeline), then model (tensor), then seq (context/ring-
+# attention), then expert (MoE).  Order matters: ICI neighbours should serve
+# the bandwidth-hungry inner axes.
+AXES = MESH_AXES
 
 
 @dataclasses.dataclass(frozen=True)

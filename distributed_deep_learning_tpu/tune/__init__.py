@@ -1,0 +1,44 @@
+"""Auto-parallelism planner: search the (mesh x microbatch x remat x ZeRO
+x compress x attention x dtype) plan lattice for a workload + topology.
+
+The source paper compares execution modes by hand; this package automates
+the choice.  Four layers:
+
+* :mod:`.space` — the immutable :class:`~.space.Plan` point and enumeration
+  of the legal lattice for a device count (legality mirrors the trainer's
+  own flag-composition rules, and mesh shapes are validated by the same
+  ``MeshSpec.resolve`` the trainer uses).
+* :mod:`.memory` — analytic HBM model (params + optimizer moments +
+  activations under each remat policy, ZeRO sharding factors) that prunes
+  infeasible plans before any compile; cross-checked per trial against
+  XLA's ``compiled.memory_analysis()``.
+* :mod:`.trial` — OOM-safe measured trials: compile once, time N steps
+  with ``StepTimer`` (sync-honest), ``RESOURCE_EXHAUSTED`` → infeasible
+  record instead of a dead search.
+* :mod:`.search` + :mod:`.artifact` — successive halving over survivors,
+  and the versioned JSON plan artifact keyed by a hash of (workload,
+  geometry, topology) that ``--plan`` replays.
+"""
+
+from distributed_deep_learning_tpu.tune.artifact import (PLAN_SCHEMA_VERSION,
+                                                         StalePlanError,
+                                                         load_plan, plan_hash,
+                                                         plan_key, save_plan)
+from distributed_deep_learning_tpu.tune.memory import (MemoryEstimate,
+                                                       ModelGeometry,
+                                                       estimate_memory,
+                                                       hbm_budget,
+                                                       prune_plans)
+from distributed_deep_learning_tpu.tune.search import SearchResult, run_search
+from distributed_deep_learning_tpu.tune.space import (Plan, apply_plan,
+                                                      enumerate_plans,
+                                                      plan_from_config)
+from distributed_deep_learning_tpu.tune.trial import TrialHarness, TrialResult
+
+__all__ = [
+    "PLAN_SCHEMA_VERSION", "StalePlanError", "load_plan", "plan_hash",
+    "plan_key", "save_plan", "MemoryEstimate", "ModelGeometry",
+    "estimate_memory", "hbm_budget", "prune_plans", "SearchResult",
+    "run_search", "Plan", "apply_plan", "enumerate_plans",
+    "plan_from_config", "TrialHarness", "TrialResult",
+]

@@ -1,0 +1,146 @@
+"""Analytic HBM model: reject plans that cannot fit BEFORE any compile.
+
+Per-device footprint of one train step under a plan, from first principles:
+
+* params — fp32 master copy, divided by the fsdp shard under ``--zero fsdp``
+* gradients — same dtype/shape as params, sharded with them under fsdp
+* optimizer — ``opt_slots`` fp32 moments per param (Adam 2, momentum 1,
+  adafactor ~sublinear ≈ 1); ZeRO-1 shards them over the shard axis, fsdp
+  shards them with the params
+* activations — one *microbatch*'s worth (batch / (dp x grad_accum)) of
+  per-layer activations, scaled by the fraction each remat policy keeps
+  live for the backward
+
+The fractions are a ranking model, not a byte-exact one — their job is a
+correct ORDER (no remat > dots > dots_no_batch > full recompute), which the
+monotonicity tests pin and each measured trial cross-checks against XLA's
+``compiled.memory_analysis()`` (see :mod:`.trial`).  Everything here is
+jax-free arithmetic; the HBM budget comes from ``device.memory_stats()``
+where the backend reports one (TPU) and is ``None`` elsewhere (CPU test
+meshes), in which case pruning only happens under an explicit override.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable, Sequence
+
+from distributed_deep_learning_tpu.tune.space import Plan
+
+#: fraction of a layer's forward activations the backward keeps live under
+#: each (remat, policy) combo.  No remat keeps everything; 'dots' keeps
+#: matmul outputs; 'dots_no_batch' keeps only batch-free matmuls (weights'
+#: contractions); policy 'nothing' under remat recomputes all but the layer
+#: boundaries.
+ACT_FRACTION: dict[tuple[bool, str], float] = {
+    (False, "nothing"): 1.00,
+    (True, "dots"): 0.60,
+    (True, "dots_no_batch"): 0.45,
+    (True, "nothing"): 0.15,
+}
+
+#: fp32 moment slots per parameter for each optimizer family; the analytic
+#: model only needs the right order of magnitude
+OPT_SLOTS = {"sgd": 0, "momentum": 1, "adam": 2, "adamw": 2, "lamb": 2,
+             "adafactor": 1, "auto": 2}
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelGeometry:
+    """What the memory model needs to know about a workload's model."""
+
+    param_count: int                     # trainable parameter count
+    num_layers: int                      # repeated-block depth
+    layer_act_elems_per_example: int     # activation elems / layer / example
+    extra_act_elems_per_example: int = 0  # embeddings / head / input staging
+    opt_slots: int = 2                   # fp32 moments per param
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryEstimate:
+    """Per-device byte estimate for one train step under a plan."""
+
+    params_bytes: int
+    gradients_bytes: int
+    optimizer_bytes: int
+    activations_bytes: int
+
+    @property
+    def total_bytes(self) -> int:
+        return (self.params_bytes + self.gradients_bytes
+                + self.optimizer_bytes + self.activations_bytes)
+
+    def to_dict(self) -> dict[str, int]:
+        return {**dataclasses.asdict(self), "total_bytes": self.total_bytes}
+
+
+def _shard_axis_size(plan: Plan) -> int:
+    """The axis ZeRO shards over — fsdp when the mesh has one, else data
+    (the same rule :mod:`..workloads.base` uses to pick the spec axis)."""
+    md = plan.mesh_dict()
+    fsdp = md.get("fsdp", 1)
+    return fsdp if fsdp > 1 else md.get("data", 1)
+
+
+def estimate_memory(plan: Plan, geom: ModelGeometry,
+                    batch_size: int) -> MemoryEstimate:
+    """Analytic per-device HBM footprint of one train step."""
+    dtype_bytes = 2 if plan.dtype == "bfloat16" else 4
+    shard = max(1, _shard_axis_size(plan))
+    params = geom.param_count * 4          # fp32 master copy
+    grads = geom.param_count * 4
+    opt = geom.opt_slots * geom.param_count * 4
+    if plan.zero == "1":
+        opt = -(-opt // shard)             # moments sharded, params whole
+    elif plan.zero == "fsdp":
+        params = -(-params // shard)
+        grads = -(-grads // shard)
+        opt = -(-opt // shard)
+    micro = max(1, batch_size // (plan.dp * plan.grad_accum))
+    frac = ACT_FRACTION[(plan.remat, plan.remat_policy)]
+    act = int(micro * (geom.num_layers * geom.layer_act_elems_per_example
+                       * frac + geom.extra_act_elems_per_example)
+              * dtype_bytes)
+    return MemoryEstimate(params_bytes=params, gradients_bytes=grads,
+                          optimizer_bytes=opt, activations_bytes=act)
+
+
+def hbm_budget(devices: Sequence[Any] | None = None,
+               override: int | None = None) -> int | None:
+    """Per-device memory budget in bytes, or None when unknown.
+
+    TPU runtimes report ``bytes_limit`` via ``device.memory_stats()``; the
+    CPU test backend reports nothing, so CPU searches only prune under an
+    explicit ``override`` (tests inject tiny/huge budgets this way)."""
+    if override is not None:
+        return override
+    if not devices:
+        return None
+    try:
+        stats = devices[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    limit = stats.get("bytes_limit")
+    return int(limit) if limit else None
+
+
+def prune_plans(plans: Iterable[Plan], geom: ModelGeometry, batch_size: int,
+                budget_bytes: int | None, *, safety: float = 0.9,
+                ) -> tuple[list[Plan], list[tuple[Plan, MemoryEstimate]]]:
+    """Split plans into (feasible, rejected-with-estimates).
+
+    ``safety`` reserves headroom for XLA temporaries the analytic model
+    cannot see (fusion scratch, collective buffers).  With no budget the
+    model cannot reject anything — every plan is feasible and the measured
+    trials' OOM containment is the backstop."""
+    feasible: list[Plan] = []
+    rejected: list[tuple[Plan, MemoryEstimate]] = []
+    for plan in plans:
+        est = estimate_memory(plan, geom, batch_size)
+        if budget_bytes is not None and est.total_bytes > safety * budget_bytes:
+            rejected.append((plan, est))
+        else:
+            feasible.append(plan)
+    return feasible, rejected

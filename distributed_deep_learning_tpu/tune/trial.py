@@ -1,0 +1,170 @@
+"""OOM-safe measured trials: one plan → (compile once, time N real steps).
+
+A trial builds the REAL training step for its plan — same model builder,
+same ``derive_state_spec`` sharding, same step-fn dispatch the trainer
+uses (:mod:`..workloads.base`) — so the measured steps/sec is the number
+training will actually see, not a proxy kernel's.  The step is compiled
+once ahead-of-time (``lower().compile()``), which also yields XLA's
+``cost_analysis`` / ``memory_analysis`` for free (the static FLOPs/bytes
+ranking and the cross-check for the analytic HBM model), then timed with
+the sync-honest :class:`~..utils.profiling.StepTimer`.
+
+Failure containment is the point: a candidate that exhausts device memory
+raises ``RESOURCE_EXHAUSTED`` somewhere inside compile or execution — the
+trial catches it and records the plan as infeasible instead of killing the
+search (chaos-drill philosophy: a bad candidate is data, not a crash).
+Tests inject fakes through ``oom_hook``; ``measure`` swaps the timing loop
+for a deterministic stand-in so search-logic tests never compile anything.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_deep_learning_tpu.data.loader import BATCH_AXES
+from distributed_deep_learning_tpu.runtime.mesh import build_mesh
+from distributed_deep_learning_tpu.train.state import create_train_state
+from distributed_deep_learning_tpu.train.step import place_state
+from distributed_deep_learning_tpu.tune.space import Plan, apply_plan
+from distributed_deep_learning_tpu.utils import profiling
+
+
+def is_oom_error(err: BaseException) -> bool:
+    """Does this exception smell like device memory exhaustion?  XLA
+    surfaces OOM as ``XlaRuntimeError`` with RESOURCE_EXHAUSTED status —
+    matched on the message because the exception class moved across
+    jaxlib versions."""
+    msg = str(err)
+    return ("RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()
+            or "OOM" in msg)
+
+
+@dataclasses.dataclass
+class TrialResult:
+    """Outcome of measuring one plan (or failing to)."""
+
+    plan: Plan
+    steps_per_sec: float = 0.0
+    examples_per_sec: float = 0.0
+    measured_steps: int = 0
+    compile_seconds: float = 0.0
+    infeasible: bool = False
+    oom: bool = False
+    error: str | None = None
+    cost: dict = dataclasses.field(default_factory=dict)     # cost_analysis
+    memory: dict = dataclasses.field(default_factory=dict)   # memory_analysis
+
+    def to_dict(self, *, deterministic_only: bool = False) -> dict[str, Any]:
+        """JSON-able record; ``deterministic_only`` drops wall-clock
+        fields so seeded searches with an injected measure compare
+        bit-identical across runs."""
+        d = {
+            "plan": self.plan.to_dict(),
+            "steps_per_sec": self.steps_per_sec,
+            "examples_per_sec": self.examples_per_sec,
+            "measured_steps": self.measured_steps,
+            "infeasible": self.infeasible,
+            "oom": self.oom,
+            "error": self.error,
+        }
+        if not deterministic_only:
+            d["compile_seconds"] = self.compile_seconds
+            d["cost"] = self.cost
+            d["memory"] = self.memory
+        return d
+
+
+class TrialHarness:
+    """Builds and times the real train step for each plan it is handed.
+
+    The probe batch is deterministic (dataset rows ``[0, batch)``) and the
+    whole harness is seeded through the config, so identical (plan, steps)
+    requests produce identical programs.  ``oom_hook(plan)`` runs before
+    any build — a test can raise a fake ``RESOURCE_EXHAUSTED`` there;
+    ``measure(plan, steps) -> steps_per_sec`` replaces the build+timing
+    path entirely for deterministic search-logic tests.
+    """
+
+    def __init__(self, spec, config, dataset, devices, *, warmup: int = 2,
+                 oom_hook: Callable[[Plan], None] | None = None,
+                 measure: Callable[[Plan, int], float] | None = None):
+        self.spec = spec
+        self.config = config
+        self.dataset = dataset
+        self.devices = list(devices)
+        self.warmup = warmup
+        self.oom_hook = oom_hook
+        self.measure = measure
+        x, y = dataset.batch(np.arange(config.batch_size))
+        self._x, self._y = np.asarray(x), np.asarray(y)
+
+    def run(self, plan: Plan, steps: int) -> TrialResult:
+        cfg = apply_plan(self.config, plan)
+        try:
+            if self.oom_hook is not None:
+                self.oom_hook(plan)
+            if self.measure is not None:
+                sps = float(self.measure(plan, steps))
+                return TrialResult(plan, steps_per_sec=sps,
+                                   examples_per_sec=sps * cfg.batch_size,
+                                   measured_steps=steps)
+            return self._run_real(cfg, plan, steps)
+        except Exception as err:  # a dead candidate must not kill the search
+            return TrialResult(plan, infeasible=True, oom=is_oom_error(err),
+                               error=f"{type(err).__name__}: {err}"[:500])
+
+    def _run_real(self, cfg, plan: Plan, steps: int) -> TrialResult:
+        from distributed_deep_learning_tpu.workloads import base
+
+        if plan.n_devices > len(self.devices):
+            raise ValueError(f"plan wants {plan.n_devices} devices, "
+                             f"have {len(self.devices)}")
+        mesh = build_mesh(cfg.mesh_shape, self.devices[:plan.n_devices])
+        model = self.spec.build_model(cfg, self.dataset)
+        example = self.spec.example_input(cfg, self.dataset)
+        loss_fn = self.spec.build_loss(cfg)
+        epoch_steps = max(1, len(self.dataset) // cfg.batch_size)
+        tx = base.build_optimizer(self.spec, cfg, epoch_steps)
+        rng = jax.random.key(cfg.seed)
+        train_rng = (jax.random.key(cfg.seed + 1)
+                     if cfg.dropout > 0 else None)
+        state = create_train_state(model, rng, example, tx,
+                                   train_rng=train_rng)
+        state_spec = base.derive_state_spec(self.spec, cfg, mesh, state)
+        state = place_state(state, mesh, state_spec)
+        train_step, _ = base.make_train_eval_steps(cfg, mesh, loss_fn,
+                                                   state_spec)
+        batch_sh = NamedSharding(mesh, P(BATCH_AXES))
+        x = jax.device_put(jnp.asarray(self._x), batch_sh)
+        y = jax.device_put(jnp.asarray(self._y), batch_sh)
+
+        t0 = time.perf_counter()
+        compiled = train_step.lower(state, x, y).compile()
+        compile_seconds = time.perf_counter() - t0
+        cost = profiling.normalize_cost_analysis(compiled.cost_analysis())
+        try:
+            memory = profiling.normalize_memory_analysis(
+                compiled.memory_analysis())
+        except Exception:
+            memory = {}
+
+        timer = profiling.StepTimer(warmup=self.warmup)
+        metrics = None
+        for _ in range(self.warmup + steps):
+            state, metrics = compiled(state, x, y)
+            timer.tick(cfg.batch_size)
+        summary = timer.summary(sync=metrics["loss"])
+        return TrialResult(
+            plan,
+            steps_per_sec=summary["steps_per_sec"],
+            examples_per_sec=summary["examples_per_sec"],
+            measured_steps=timer.measured_steps,
+            compile_seconds=compile_seconds,
+            cost=cost, memory=memory)

@@ -32,6 +32,12 @@ REMAT_POLICIES = {
     "dots_no_batch": "dots_with_no_batch_dims_saveable",
 }
 
+#: Canonical mesh axis order.  Lives here (jax-free, same reasoning as
+#: REMAT_POLICIES) so ``--mesh`` can be validated at parse time;
+#: ``runtime/mesh.py`` re-exports it as ``AXES`` and builds the actual
+#: ``jax.sharding.Mesh`` in this order.
+MESH_AXES = ("data", "fsdp", "stage", "model", "seq", "expert")
+
 
 class Mode(str, enum.Enum):
     """Execution mode, 1:1 with the reference CLI (`-m`)."""
@@ -186,6 +192,12 @@ class Config:
     elastic: bool = False               # checkpointed restart on failure
     heartbeat_dir: str | None = None    # shared dir for liveness heartbeats
     heartbeat_timeout: float = 30.0     # seconds before a peer counts as dead
+    autotune: bool = False              # search the plan lattice (tune/)
+                                        #   before training and train under
+                                        #   the best measured plan
+    plan_file: str | None = None        # plan artifact path: --plan loads and
+                                        #   applies it; with --autotune the
+                                        #   search result is written here
     distributed: DistributedEnv = dataclasses.field(default_factory=DistributedEnv)
 
     def replace(self, **kw) -> "Config":
@@ -416,6 +428,17 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
                         "--elastic, dead peers abort the step promptly "
                         "instead of hanging the collective")
     p.add_argument("--heartbeat-timeout", type=float, default=30.0)
+    p.add_argument("--autotune", action="store_true",
+                   help="search the mesh x microbatch x remat x ZeRO plan "
+                        "lattice (tune/) with memory-model pruning and "
+                        "measured trials, write the winning plan artifact "
+                        "(--plan sets the path), then train under it")
+    p.add_argument("--plan", dest="plan_file", type=str, default=None,
+                   metavar="FILE",
+                   help="apply a plan artifact from a previous --autotune "
+                        "run (rejected if its key does not match this "
+                        "workload/geometry/topology); with --autotune, "
+                        "where to write the search result")
     return p
 
 
@@ -434,14 +457,39 @@ def parse_buckets_arg(text: str | None) -> tuple[int, ...] | None:
 
 
 def parse_mesh_arg(text: str | None) -> dict[str, int] | None:
+    """``--mesh`` string → shape dict, validated at parse time.
+
+    A bad mesh string is an argparse-style error naming the known axes —
+    not a ``ValueError`` traceback from ``MeshSpec`` deep inside startup.
+    The device-count constraint (axis product vs. available devices) is
+    checked later by ``MeshSpec.resolve``, which knows the topology.
+    """
     if not text:
         return None
     shape: dict[str, int] = {}
     for part in text.split(","):
         axis, _, n = part.partition("=")
+        axis = axis.strip()
         if not n:
-            raise ValueError(f"bad --mesh entry {part!r}; expected axis=N")
-        shape[axis.strip()] = int(n)
+            raise SystemExit(f"--mesh: bad entry {part!r}; expected axis=N "
+                             f"with axis one of {', '.join(MESH_AXES)}")
+        if axis not in MESH_AXES:
+            raise SystemExit(f"--mesh: unknown axis {axis!r}; known axes: "
+                             f"{', '.join(MESH_AXES)}")
+        if axis in shape:
+            raise SystemExit(f"--mesh: axis {axis!r} given twice")
+        try:
+            size = int(n)
+        except ValueError:
+            raise SystemExit(f"--mesh: size for axis {axis!r} must be an "
+                             f"integer (-1 = fill remaining devices), got "
+                             f"{n.strip()!r}") from None
+        if size == 0 or size < -1:
+            raise SystemExit(f"--mesh: size for axis {axis!r} must be >= 1 "
+                             "(or -1 to fill with the remaining devices)")
+        shape[axis] = size
+    if sum(1 for v in shape.values() if v == -1) > 1:
+        raise SystemExit("--mesh: at most one axis may be -1")
     return shape
 
 
@@ -469,6 +517,15 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
                                    or args.sentinel_factor <= 1.0):
         raise SystemExit("--sentinel-window must be >= 1 and "
                          "--sentinel-factor > 1")
+    mesh_shape = parse_mesh_arg(args.mesh)
+    if mesh_shape and args.nstages and \
+            mesh_shape.get("stage", args.nstages) != args.nstages:
+        raise SystemExit(f"--mesh stage={mesh_shape['stage']} conflicts "
+                         f"with --nstages {args.nstages}; drop one (--mesh "
+                         "wins over the mode-derived stage count)")
+    if args.plan_file and not args.autotune and not os.path.exists(args.plan_file):
+        raise SystemExit(f"--plan {args.plan_file}: no such file (run "
+                         "--autotune to produce one)")
     return Config(
         num_layers=args.nlayers,
         size=args.size,
@@ -483,7 +540,7 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         learning_rate=args.lr,
         dtype=args.dtype,
         num_stages=args.nstages,
-        mesh_shape=parse_mesh_arg(args.mesh),
+        mesh_shape=mesh_shape,
         double_softmax=args.double_softmax,
         sync_in_local_data_mode=args.sync,
         zero=args.zero,
@@ -522,5 +579,7 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         elastic=args.elastic,
         heartbeat_dir=args.heartbeat_dir,
         heartbeat_timeout=args.heartbeat_timeout,
+        autotune=args.autotune,
+        plan_file=args.plan_file,
         distributed=dist,
     )

@@ -13,6 +13,9 @@ here:
   analogue; there are no "graph breaks" to hunt — if it traced, it's one
   program — but fusion/layout decisions live in the optimised HLO).
 * :func:`cost_analysis` — XLA's FLOP/byte estimates for a jitted call.
+* :func:`memory_analysis` — XLA's compiled-memory breakdown (argument /
+  output / temp / code bytes); the tune/ planner cross-checks its analytic
+  HBM model against this.
 * :class:`StepTimer` — steps/sec / examples/sec meter with warmup skip.
 * :func:`measure_async_overlap` — dispatch-vs-completion split for a
   staged/pipelined callable: evidence that the host enqueues the whole
@@ -65,12 +68,55 @@ def compiled_text(fn: Callable, *args, **kwargs) -> str:
     return _lowered(fn, *args, **kwargs).compile().as_text()
 
 
-def cost_analysis(fn: Callable, *args, **kwargs) -> dict[str, Any]:
-    """XLA's cost model for one call: flops, bytes accessed, etc."""
-    analysis = _lowered(fn, *args, **kwargs).compile().cost_analysis()
-    if isinstance(analysis, (list, tuple)):  # some backends wrap in a list
+def normalize_cost_analysis(analysis: Any) -> dict[str, Any]:
+    """``Compiled.cost_analysis()`` output → plain dict (some backends wrap
+    the dict in a single-element list)."""
+    if isinstance(analysis, (list, tuple)):
         analysis = analysis[0] if analysis else {}
     return dict(analysis) if analysis else {}
+
+
+def cost_analysis(fn: Callable, *args, **kwargs) -> dict[str, Any]:
+    """XLA's cost model for one call: flops, bytes accessed, etc."""
+    compiled = _lowered(fn, *args, **kwargs).compile()
+    return normalize_cost_analysis(compiled.cost_analysis())
+
+
+#: the stable integer fields of XLA's CompiledMemoryStats (the proto also
+#: carries a serialized HLO blob — never surfaced here)
+_MEMORY_FIELDS = (
+    "generated_code_size_in_bytes", "argument_size_in_bytes",
+    "output_size_in_bytes", "alias_size_in_bytes", "temp_size_in_bytes",
+    "host_generated_code_size_in_bytes", "host_argument_size_in_bytes",
+    "host_output_size_in_bytes", "host_alias_size_in_bytes",
+    "host_temp_size_in_bytes",
+)
+
+
+def normalize_memory_analysis(stats: Any) -> dict[str, int]:
+    """``Compiled.memory_analysis()`` output → dict of its stable integer
+    fields, ``{}`` when the backend reports nothing."""
+    if stats is None:
+        return {}
+    out: dict[str, int] = {}
+    for field in _MEMORY_FIELDS:
+        value = getattr(stats, field, None)
+        if isinstance(value, int):
+            out[field] = value
+    return out
+
+
+def memory_analysis(fn: Callable, *args, **kwargs) -> dict[str, int]:
+    """XLA's compiled-memory breakdown for one call — argument / output /
+    temp / generated-code bytes on device (plus host_* variants where the
+    backend offloads).  The static sibling of a profiler HBM trace: it is
+    known the moment compilation finishes, before anything runs.  Returns
+    ``{}`` on backends that don't report memory stats."""
+    try:
+        stats = _lowered(fn, *args, **kwargs).compile().memory_analysis()
+    except Exception:
+        return {}
+    return normalize_memory_analysis(stats)
 
 
 class StepTimer:

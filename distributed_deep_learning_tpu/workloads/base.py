@@ -367,6 +367,87 @@ def _restore_resume(ckpt, state, ckpt_step, start_epoch, resume_batch,
     return restored, start_epoch, resume_batch, resume_totals
 
 
+def derive_state_spec(spec: WorkloadSpec, config: Config, mesh, state):
+    """Sharding spec for the train state under (``--mesh``, ``--zero``):
+    tensor-parallel rules when the mesh has model/expert axes, ZeRO-1/fsdp
+    sharding otherwise, replicated by default.  Shared by the trainer and
+    the tune/ trial harness so a measured trial exercises the exact specs
+    training would use."""
+    state_spec = P()
+    if mesh.shape.get("model", 1) > 1 or mesh.shape.get("expert", 1) > 1:
+        if spec.tp_rules is None:
+            raise ValueError(f"workload {spec.name!r} has no "
+                             "tensor-parallel sharding rules")
+        if config.zero != "none":
+            raise ValueError("--zero with a model axis is not supported "
+                             "yet; use fsdp_axis in the TP rules instead")
+        from distributed_deep_learning_tpu.parallel.tensor_parallel import (
+            tp_state_spec, validate_divisibility)
+
+        rules = spec.tp_rules(config)
+        validate_divisibility(state.params, mesh, rules)
+        state_spec = tp_state_spec(state, rules)
+    elif config.zero != "none":
+        from distributed_deep_learning_tpu.parallel.zero import (
+            fsdp_state_spec, zero1_state_spec)
+
+        axis = "fsdp" if mesh.shape.get("fsdp", 1) > 1 else "data"
+        make_spec = zero1_state_spec if config.zero == "1" \
+            else fsdp_state_spec
+        state_spec = make_spec(state, mesh, axis=axis)
+    return state_spec
+
+
+def make_train_eval_steps(config: Config, mesh, loss_fn, state_spec,
+                          sentinel=None):
+    """(train_step, eval_step) for the SEQUENTIAL/DATA family, dispatching
+    to the compressed / accumulating / plain step builders exactly as the
+    trainer does (flag combinations the builders cannot honour are
+    rejected, not silently dropped).  Shared with the tune/ trial harness.
+    """
+    if config.grad_compress != "none":
+        if config.zero != "none" or config.grad_accum > 1 \
+                or mesh.shape.get("model", 1) > 1 \
+                or mesh.shape.get("expert", 1) > 1:
+            raise ValueError(
+                "--grad-compress applies to the pure data-parallel "
+                "gradient all-reduce; it does not compose with "
+                "--zero/--grad-accum/--mesh model/expert axes")
+        from distributed_deep_learning_tpu.train.compress import (
+            make_compressed_step_fns)
+
+        return make_compressed_step_fns(
+            mesh, loss_fn, method=config.grad_compress,
+            remat=config.remat, remat_policy=config.remat_policy)
+    if config.grad_accum > 1:
+        if config.remat:
+            # rejected, not silently dropped (round-1 advisor
+            # principle): the accumulation scan has no remat wiring
+            raise ValueError("--remat with --grad-accum is not "
+                             "implemented; drop one of the two")
+        from distributed_deep_learning_tpu.train.accumulate import (
+            make_accum_step_fns)
+
+        return make_accum_step_fns(
+            mesh, loss_fn, accum_steps=config.grad_accum,
+            state_spec=state_spec)
+    return make_step_fns(
+        mesh, loss_fn, state_spec=state_spec, remat=config.remat,
+        remat_policy=config.remat_policy, sentinel=sentinel)
+
+
+def mesh_devices(shape: dict[str, int], devices):
+    """The device prefix an explicit mesh shape occupies: a plan's
+    1-device corner must run on an 8-device box (axis product < device
+    count), while a -1 fill keeps every device."""
+    n = 1
+    for s in shape.values():
+        if s == -1:
+            return devices
+        n *= s
+    return devices[:n] if n <= len(devices) else devices
+
+
 def _sentinel_config(config: Config):
     """``--sentinel`` → a :class:`..train.sentinel.SentinelConfig` (or
     None), validated against flags whose step builders have no sentinel
@@ -648,6 +729,10 @@ def run_workload(spec: WorkloadSpec, config: Config
         dataset = _build_dataset(spec, config)
         if spec.pre_train_check is not None:
             spec.pre_train_check(config, dataset)
+        if config.autotune or config.plan_file:
+            # plan fields never affect dataset construction, so the built
+            # dataset is reused by the search's measured trials
+            config = _resolve_plan(spec, config, devices, logger, dataset)
         state, history = _run_workload(spec, config, devices, logger,
                                        dataset)
         if (config.generate_tokens or config.serve) and \
@@ -656,6 +741,47 @@ def run_workload(spec: WorkloadSpec, config: Config
         return state, history
     finally:
         logger.close()
+
+
+def _resolve_plan(spec: WorkloadSpec, config: Config, devices, logger,
+                  dataset) -> Config:
+    """``--autotune`` / ``--plan FILE`` → the config the run actually uses.
+
+    Autotune searches the plan lattice with measured trials (reusing the
+    already-built dataset), writes the artifact, and applies the winner;
+    ``--plan`` alone loads an artifact, verifies its key against this
+    run's (workload, geometry, topology), and applies it.  Either way the
+    result is plain ``Config`` field overrides — every downstream code
+    path is unchanged."""
+    from distributed_deep_learning_tpu.tune import artifact as plan_artifact
+    from distributed_deep_learning_tpu.tune.space import apply_plan
+
+    platform = devices[0].platform
+    device_kind = getattr(devices[0], "device_kind", "")
+    key = plan_artifact.plan_key(spec.name, config, len(devices),
+                                 platform, device_kind)
+    if config.autotune:
+        from distributed_deep_learning_tpu.tune.search import run_search
+
+        result = run_search(spec, config, devices=devices, dataset=dataset,
+                            logger=logger)
+        path = config.plan_file or f"autotune_{spec.name}.plan.json"
+        plan_artifact.save_plan(
+            path, result.best, key=key, workload=spec.name,
+            topology={"n_devices": len(devices), "platform": platform,
+                      "device_kind": device_kind},
+            search=result.record())
+        logger.info(
+            f"autotune: best plan {plan_artifact.plan_hash(result.best)} "
+            f"[{result.best.describe()}] "
+            f"{result.best_sps:.2f} steps/s vs baseline "
+            f"{result.baseline_sps:.2f}; artifact -> {path}")
+        return apply_plan(config, result.best)
+    plan, record = plan_artifact.load_plan(config.plan_file,
+                                           expected_key=key)
+    logger.info(f"plan {record['plan_hash']} [{plan.describe()}] applied "
+                f"from {config.plan_file}")
+    return apply_plan(config, plan)
 
 
 def _build_dataset(spec: WorkloadSpec, config: Config):
@@ -707,7 +833,8 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
                 n = config.world_size if config.world_size > 1 \
                     else len(devices)
             if config.mesh_shape:
-                mesh = build_mesh(config.mesh_shape, devices)
+                mesh = build_mesh(config.mesh_shape,
+                                  mesh_devices(config.mesh_shape, devices))
             elif not config.sync_in_local_data_mode:
                 # reference quirk Q1 replication: local `data` mode trained N
                 # INDEPENDENT replicas and printed rank 0's metrics.  The
@@ -742,59 +869,10 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
             # attach BEFORE deriving sharding specs: the spec builders map
             # the sentinel scalars to replicated specs alongside the rest
             state = attach_sentinel(state)
-        state_spec = P()
-        if mesh.shape.get("model", 1) > 1 or mesh.shape.get("expert", 1) > 1:
-            if spec.tp_rules is None:
-                raise ValueError(f"workload {spec.name!r} has no "
-                                 "tensor-parallel sharding rules")
-            if config.zero != "none":
-                raise ValueError("--zero with a model axis is not supported "
-                                 "yet; use fsdp_axis in the TP rules instead")
-            from distributed_deep_learning_tpu.parallel.tensor_parallel import (
-                tp_state_spec, validate_divisibility)
-
-            rules = spec.tp_rules(config)
-            validate_divisibility(state.params, mesh, rules)
-            state_spec = tp_state_spec(state, rules)
-        elif config.zero != "none":
-            from distributed_deep_learning_tpu.parallel.zero import (
-                fsdp_state_spec, zero1_state_spec)
-
-            axis = "fsdp" if mesh.shape.get("fsdp", 1) > 1 else "data"
-            make_spec = zero1_state_spec if config.zero == "1" \
-                else fsdp_state_spec
-            state_spec = make_spec(state, mesh, axis=axis)
+        state_spec = derive_state_spec(spec, config, mesh, state)
         state = place_state(state, mesh, state_spec)
-        if config.grad_compress != "none":
-            if config.zero != "none" or config.grad_accum > 1 \
-                    or mesh.shape.get("model", 1) > 1 \
-                    or mesh.shape.get("expert", 1) > 1:
-                raise ValueError(
-                    "--grad-compress applies to the pure data-parallel "
-                    "gradient all-reduce; it does not compose with "
-                    "--zero/--grad-accum/--mesh model/expert axes")
-            from distributed_deep_learning_tpu.train.compress import (
-                make_compressed_step_fns)
-
-            train_step, eval_step = make_compressed_step_fns(
-                mesh, loss_fn, method=config.grad_compress,
-                remat=config.remat, remat_policy=config.remat_policy)
-        elif config.grad_accum > 1:
-            if config.remat:
-                # rejected, not silently dropped (round-1 advisor
-                # principle): the accumulation scan has no remat wiring
-                raise ValueError("--remat with --grad-accum is not "
-                                 "implemented; drop one of the two")
-            from distributed_deep_learning_tpu.train.accumulate import (
-                make_accum_step_fns)
-
-            train_step, eval_step = make_accum_step_fns(
-                mesh, loss_fn, accum_steps=config.grad_accum,
-                state_spec=state_spec)
-        else:
-            train_step, eval_step = make_step_fns(
-                mesh, loss_fn, state_spec=state_spec, remat=config.remat,
-                remat_policy=config.remat_policy, sentinel=sentinel)
+        train_step, eval_step = make_train_eval_steps(
+            config, mesh, loss_fn, state_spec, sentinel=sentinel)
         ckpt, ckpt_step, start_epoch, resume_batch, resume_totals = \
             _maybe_checkpointer(config)
         if config.elastic:

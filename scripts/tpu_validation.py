@@ -349,6 +349,54 @@ def serving(n_requests=48, max_slots=16):
     return {"section": "serving", "on_tpu": on_tpu, **rec}
 
 
+def autotune(workload="gpt"):
+    """Auto-parallelism planner on real hardware: search the plan lattice
+    for a TPU-shaped LM geometry (small-GPT on TPU, toy on CPU smoke) and
+    report the winning plan + measured best-vs-default step rate.  On TPU
+    this is the first run where the analytic HBM model has a real
+    ``bytes_limit`` budget to prune against and ``memory_analysis()``
+    reports device bytes — the cross-check data the CPU box cannot
+    produce."""
+    import jax
+
+    from distributed_deep_learning_tpu.tune.artifact import plan_hash
+    from distributed_deep_learning_tpu.tune.memory import hbm_budget
+    from distributed_deep_learning_tpu.tune.search import run_search
+    from distributed_deep_learning_tpu.utils.config import parse_args
+    from distributed_deep_learning_tpu.workloads import get_spec
+
+    on_tpu = jax.default_backend() == "tpu"
+    argv = (["-e", "1", "-b", "64", "-m", "data", "-l", "4", "-s", "256"]
+            if on_tpu else
+            ["-e", "1", "-b", "16", "-m", "data", "-l", "2", "-s", "64"])
+    os.environ.setdefault("DDL_DATA_LIMIT", "512")
+    spec = get_spec(workload)
+    config = parse_args(argv, workload=workload)
+    result = run_search(
+        spec, config, trial_steps=4 if on_tpu else 2,
+        max_trials=8 if on_tpu else 4,
+        space_options=dict(zero_options=("none", "fsdp"),
+                           compress_options=("none",),
+                           grad_accum_options=(1,)))
+    best_trial = next((t for t in result.trials
+                       if t.plan == result.best and not t.infeasible), None)
+    return {
+        "section": "autotune", "on_tpu": on_tpu, "workload": workload,
+        "plan_hash": plan_hash(result.best),
+        "plan": result.best.describe(),
+        "best_steps_per_sec": round(result.best_sps, 3),
+        "baseline_steps_per_sec": round(result.baseline_sps, 3),
+        "speedup": round(result.best_sps / result.baseline_sps, 4)
+            if result.baseline_sps else None,
+        "n_candidates": result.n_candidates,
+        "n_pruned_analytic": result.n_pruned,
+        "n_infeasible": result.n_infeasible,
+        "hbm_budget_bytes": hbm_budget(jax.devices()),
+        "xla_memory_analysis": best_trial.memory if best_trial else {},
+        "search_seconds": round(result.search_seconds, 1),
+    }
+
+
 def _record_flash_gate(result: dict) -> None:
     """Persist the measured ratio as the `--attention auto` gate datum."""
     from distributed_deep_learning_tpu.utils.bench_records import (
@@ -359,7 +407,7 @@ def _record_flash_gate(result: dict) -> None:
 
 SECTIONS = ("flash_block_sweep", "flash_vs_dense", "gqa_speedup",
             "s2d_vs_plain", "batch_sweep", "lm_tokens", "serving",
-            "mfu_diag", "lm_sweep")
+            "autotune", "mfu_diag", "lm_sweep")
 
 
 def _run_section(name: str) -> None:

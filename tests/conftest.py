@@ -48,7 +48,7 @@ SMOKE_FILES = {
     "test_tensor_parallel.py", "test_ulysses.py", "test_fused_ce.py",
     "test_profiling.py", "test_schedules.py", "test_compress.py",
     "test_host_pipeline.py", "test_attention_pallas.py",
-    "test_torch_migrate.py", "test_chaos.py",
+    "test_torch_migrate.py", "test_chaos.py", "test_tune.py",
 }
 
 

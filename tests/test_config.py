@@ -59,6 +59,51 @@ def test_explicit_env_beats_mpi():
 def test_mesh_arg():
     assert parse_mesh_arg("data=4,stage=2") == {"data": 4, "stage": 2}
     assert parse_mesh_arg(None) is None
+    assert parse_mesh_arg("") is None
+    assert parse_mesh_arg("data=-1,model=2") == {"data": -1, "model": 2}
+
+
+def test_mesh_arg_rejects_bad_strings():
+    import pytest
+
+    # a bad --mesh is a parse-time argparse-style error naming the known
+    # axes, not a MeshSpec ValueError from deep inside startup
+    with pytest.raises(SystemExit, match="known axes.*data.*fsdp"):
+        parse_mesh_arg("batch=4")
+    with pytest.raises(SystemExit, match="expected axis=N"):
+        parse_mesh_arg("data")
+    with pytest.raises(SystemExit, match="given twice"):
+        parse_mesh_arg("data=2,data=4")
+    with pytest.raises(SystemExit, match="must be an integer"):
+        parse_mesh_arg("data=two")
+    with pytest.raises(SystemExit, match="must be >= 1"):
+        parse_mesh_arg("data=0")
+    with pytest.raises(SystemExit, match="at most one axis may be -1"):
+        parse_mesh_arg("data=-1,fsdp=-1")
+
+
+def test_mesh_stage_nstages_conflict():
+    import pytest
+
+    with pytest.raises(SystemExit, match="conflicts with --nstages"):
+        parse_args(["--mesh", "stage=4", "--nstages", "2"], workload="mlp")
+    # agreeing values are fine
+    c = parse_args(["--mesh", "stage=2", "--nstages", "2"], workload="mlp")
+    assert c.mesh_shape == {"stage": 2}
+
+
+def test_autotune_plan_flags():
+    import pytest
+
+    c = parse_args(["--autotune"], workload="mlp")
+    assert c.autotune and c.plan_file is None
+    # --plan with --autotune is the OUTPUT path; it need not exist yet
+    c = parse_args(["--autotune", "--plan", "/tmp/_no_such.plan.json"],
+                   workload="mlp")
+    assert c.autotune and c.plan_file == "/tmp/_no_such.plan.json"
+    # --plan alone replays an artifact: a missing file fails at parse time
+    with pytest.raises(SystemExit, match="no such file"):
+        parse_args(["--plan", "/tmp/_no_such.plan.json"], workload="mlp")
 
 
 def test_config_immutable_replace():

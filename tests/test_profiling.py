@@ -7,7 +7,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_deep_learning_tpu.utils.profiling import (
-    StepTimer, annotate, compiled_text, cost_analysis, hlo_text, trace)
+    StepTimer, annotate, compiled_text, cost_analysis, hlo_text,
+    memory_analysis, normalize_cost_analysis, normalize_memory_analysis,
+    trace)
 
 
 def _fn(x):
@@ -29,6 +31,33 @@ def test_cost_analysis_reports_flops():
     stats = cost_analysis(_fn, jnp.zeros((64, 64)))
     # 64x64x64 matmul ≈ 524k flops; XLA reports at least the matmul
     assert stats.get("flops", 0) > 1e5
+
+
+def test_memory_analysis_reports_buffer_bytes():
+    stats = memory_analysis(_fn, jnp.zeros((64, 64)))
+    # the CPU backend reports CompiledMemoryStats too; every surfaced
+    # field is a plain int (the proto blob is excluded by design)
+    assert stats and all(isinstance(v, int) for v in stats.values())
+    # the 64x64 f32 argument buffer is at least 16 KiB
+    assert stats["argument_size_in_bytes"] >= 64 * 64 * 4
+    assert "serialized_hlo_proto" not in stats
+
+
+def test_normalize_memory_analysis_handles_missing():
+    assert normalize_memory_analysis(None) == {}
+
+    class Partial:                       # older jaxlibs expose fewer fields
+        temp_size_in_bytes = 7
+
+    assert normalize_memory_analysis(Partial()) == {"temp_size_in_bytes": 7}
+
+
+def test_normalize_cost_analysis_unwraps_list():
+    # cost_analysis() is list-wrapped on some backends, bare on others
+    assert normalize_cost_analysis([{"flops": 2.0}]) == {"flops": 2.0}
+    assert normalize_cost_analysis({"flops": 2.0}) == {"flops": 2.0}
+    assert normalize_cost_analysis(None) == {}
+    assert normalize_cost_analysis([]) == {}
 
 
 def test_trace_writes_files(tmp_path):

@@ -1,0 +1,344 @@
+"""Auto-parallelism planner: lattice, memory model, artifact, trials, search.
+
+Fast tests never compile anything — search logic runs under an injected
+``measure`` and chaos enters through ``oom_hook`` (the planner's two
+seams); the measured-trial paths (real compiles, ``run_workload`` with
+``--autotune`` / ``--plan``) are ``slow``-marked.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from distributed_deep_learning_tpu.tune import (
+    MemoryEstimate, ModelGeometry, Plan, StalePlanError, TrialHarness,
+    apply_plan, enumerate_plans, estimate_memory, hbm_budget, load_plan,
+    plan_from_config, plan_hash, plan_key, prune_plans, run_search,
+    save_plan)
+from distributed_deep_learning_tpu.tune import artifact as artifact_mod
+from distributed_deep_learning_tpu.tune.space import _normalize_mesh
+from distributed_deep_learning_tpu.utils.config import Mode, parse_args
+from distributed_deep_learning_tpu.workloads import get_spec, run_workload
+
+GEOM = ModelGeometry(param_count=1_000_000, num_layers=4,
+                     layer_act_elems_per_example=4096,
+                     extra_act_elems_per_example=1024)
+
+
+# ---------------------------------------------------------------- lattice
+
+def test_lattice_count_anchor():
+    # 3 (data,fsdp) factorizations of 4, 23 legal knob combos each; pinned
+    # so an accidental legality change shows up as a count change
+    assert len(enumerate_plans(4, 32)) == 69
+    assert len(enumerate_plans(8, 32)) == 92
+
+
+def test_lattice_plans_unique_and_hashable():
+    plans = enumerate_plans(8, 32)
+    assert len(set(plans)) == len(plans)
+    assert len({plan_hash(p) for p in plans}) == len(plans)
+
+
+def test_lattice_legality_invariants():
+    for p in enumerate_plans(8, 32):
+        assert p.n_devices == 8          # every mesh uses the whole slice
+        assert 32 % (p.dp * p.grad_accum) == 0
+        if not p.remat:
+            assert p.remat_policy == "nothing"
+        if p.grad_accum > 1:
+            assert not p.remat           # no remat wiring in the accum scan
+        if p.grad_compress != "none":    # compress needs pure DP
+            assert p.zero == "none" and p.grad_accum == 1 and p.dp > 1
+        if p.zero != "none":             # ZeRO needs a >1 shard axis
+            md = p.mesh_dict()
+            shard = md.get("fsdp", 1) if md.get("fsdp", 1) > 1 \
+                else md.get("data", 1)
+            assert shard > 1
+
+
+def test_lattice_indivisible_batch_is_empty():
+    # every mesh candidate spans all 8 devices, so dp=8 never divides 12
+    assert enumerate_plans(8, 12) == []
+
+
+def test_lattice_space_options_restrict():
+    full = enumerate_plans(8, 32)
+    small = enumerate_plans(8, 32, zero_options=("none",),
+                            compress_options=("none",),
+                            grad_accum_options=(1,))
+    assert len(small) < len(full)
+    assert all(p.zero == "none" and p.grad_compress == "none"
+               and p.grad_accum == 1 for p in small)
+    assert set(small) <= set(full)
+
+
+def test_plan_roundtrip_and_normalize():
+    p = Plan(mesh=(("data", 2), ("fsdp", 4)), remat=True,
+             remat_policy="dots", zero="fsdp")
+    q = Plan.from_dict(json.loads(json.dumps(p.to_dict())))
+    assert q == p and plan_hash(q) == plan_hash(p)
+    # size-1 axes are dropped; the all-trivial mesh keeps data=1
+    assert _normalize_mesh({"data": 1, "fsdp": 4}) == (("fsdp", 4),)
+    assert _normalize_mesh({"data": 1}) == (("data", 1),)
+
+
+def test_apply_plan_sets_config_fields():
+    config = parse_args([], workload="mlp")
+    p = Plan(mesh=(("data", 4), ("fsdp", 2)), remat=True,
+             remat_policy="dots", zero="fsdp", dtype="bfloat16")
+    cfg = apply_plan(config, p)
+    assert cfg.mode is Mode.DATA
+    assert cfg.mesh_shape == {"data": 4, "fsdp": 2}
+    assert cfg.remat and cfg.remat_policy == "dots"
+    assert cfg.zero == "fsdp" and cfg.dtype == "bfloat16"
+    # the applied config corresponds back to the same plan (replay closure)
+    assert plan_from_config(cfg, 8) == p
+
+
+def test_plan_from_config_baseline():
+    config = parse_args(["-m", "data"], workload="mlp")
+    base = plan_from_config(config, 8)
+    assert base.mesh == (("data", 8),)
+    assert base.grad_accum == 1 and not base.remat
+
+
+# ----------------------------------------------------------- memory model
+
+def test_memory_remat_monotonic():
+    acts = [estimate_memory(
+        Plan(mesh=(("data", 4),), remat=remat, remat_policy=policy),
+        GEOM, 32).activations_bytes
+        for remat, policy in [(False, "nothing"), (True, "dots"),
+                              (True, "dots_no_batch"), (True, "nothing")]]
+    assert acts == sorted(acts, reverse=True)
+    assert acts[0] > acts[-1]            # strict: remat must buy something
+
+
+def test_memory_zero_shards_state():
+    plain = estimate_memory(Plan(mesh=(("data", 8),)), GEOM, 32)
+    zero1 = estimate_memory(Plan(mesh=(("data", 8),), zero="1"), GEOM, 32)
+    fsdp = estimate_memory(
+        Plan(mesh=(("data", 2), ("fsdp", 4)), zero="fsdp"), GEOM, 32)
+    assert zero1.optimizer_bytes < plain.optimizer_bytes
+    assert zero1.params_bytes == plain.params_bytes   # ZeRO-1: moments only
+    assert fsdp.params_bytes < plain.params_bytes
+    assert fsdp.gradients_bytes < plain.gradients_bytes
+    assert fsdp.optimizer_bytes < plain.optimizer_bytes
+
+
+def test_memory_microbatch_and_dtype():
+    p1 = Plan(mesh=(("data", 4),))
+    p2 = Plan(mesh=(("data", 4),), grad_accum=2)
+    assert estimate_memory(p2, GEOM, 32).activations_bytes \
+        < estimate_memory(p1, GEOM, 32).activations_bytes
+    bf = Plan(mesh=(("data", 4),), dtype="bfloat16")
+    assert estimate_memory(bf, GEOM, 32).activations_bytes \
+        == estimate_memory(p1, GEOM, 32).activations_bytes // 2
+
+
+def test_prune_budget_override():
+    plans = enumerate_plans(8, 32)
+    feasible, rejected = prune_plans(plans, GEOM, 32, None)
+    assert feasible == plans and rejected == []      # no budget → no prune
+    feasible, rejected = prune_plans(plans, GEOM, 32, 1)
+    assert feasible == [] and len(rejected) == len(plans)
+    assert all(isinstance(e, MemoryEstimate) for _, e in rejected)
+    feasible, _ = prune_plans(plans, GEOM, 32, 1 << 60)
+    assert feasible == plans
+
+
+def test_hbm_budget_cpu_is_none():
+    import jax
+    assert hbm_budget(jax.devices()) is None         # CPU reports no stats
+    assert hbm_budget(jax.devices(), override=12345) == 12345
+    assert hbm_budget(None) is None
+
+
+# -------------------------------------------------------------- artifact
+
+def _plan():
+    return Plan(mesh=(("data", 8),), remat=True, remat_policy="dots")
+
+
+def test_artifact_roundtrip(tmp_path):
+    path = str(tmp_path / "p.plan.json")
+    config = parse_args([], workload="mlp")
+    key = plan_key("mlp", config, 8, "cpu", "cpu")
+    record = save_plan(path, _plan(), key=key, workload="mlp",
+                       topology={"n_devices": 8})
+    plan, loaded = load_plan(path, expected_key=key)
+    assert plan == _plan()
+    assert loaded["plan_hash"] == record["plan_hash"] == plan_hash(plan)
+    assert loaded["version"] == artifact_mod.PLAN_SCHEMA_VERSION
+
+
+def test_artifact_rejects_stale_key(tmp_path):
+    path = str(tmp_path / "p.plan.json")
+    config = parse_args([], workload="mlp")
+    save_plan(path, _plan(), key=plan_key("mlp", config, 8), workload="mlp")
+    other = plan_key("mlp", config.replace(batch_size=config.batch_size * 2),
+                     8)
+    with pytest.raises(StalePlanError, match="different workload"):
+        load_plan(path, expected_key=other)
+    # key hashes geometry+topology, not searched knobs
+    assert plan_key("mlp", config, 8) != plan_key("gpt", config, 8)
+    assert plan_key("mlp", config, 8) != plan_key("mlp", config, 4)
+
+
+def test_artifact_rejects_foreign_version(tmp_path):
+    path = str(tmp_path / "p.plan.json")
+    save_plan(path, _plan(), key="k", workload="mlp")
+    rec = json.load(open(path))
+    rec["version"] = 999
+    json.dump(rec, open(path, "w"))
+    with pytest.raises(StalePlanError, match="schema version"):
+        load_plan(path)
+
+
+def test_artifact_rejects_edited_plan(tmp_path):
+    path = str(tmp_path / "p.plan.json")
+    save_plan(path, _plan(), key="k", workload="mlp")
+    rec = json.load(open(path))
+    rec["plan"]["remat_policy"] = "dots_no_batch"    # hand-edited artifact
+    json.dump(rec, open(path, "w"))
+    with pytest.raises(StalePlanError, match="plan_hash"):
+        load_plan(path)
+
+
+# ------------------------------------------------- search (no compiles)
+
+def _mlp_fixture():
+    config = parse_args(["-b", "32", "-m", "data"], workload="mlp")
+    return get_spec("mlp"), config
+
+
+def _fake_measure(plan, steps):
+    """Deterministic pure function of the plan: hash → pseudo steps/sec."""
+    return 100.0 + int(plan_hash(plan), 16) % 997
+
+
+def test_search_with_injected_measure_best_wins():
+    spec, config = _mlp_fixture()
+    result = run_search(spec, config, measure=_fake_measure, max_trials=8)
+    assert result.best_sps >= result.baseline_sps
+    assert result.best_sps == max(
+        t.steps_per_sec for t in result.trials if not t.infeasible)
+    assert result.n_candidates == 92 and result.n_pruned == 0
+    assert result.n_capped == 92 - 8
+    assert result.rungs >= 1
+
+
+def test_search_deterministic_across_runs():
+    spec, config = _mlp_fixture()
+    records = []
+    for _ in range(2):
+        r = run_search(spec, config, measure=_fake_measure, max_trials=8)
+        records.append(json.dumps(r.record(deterministic_only=True),
+                                  sort_keys=True))
+    assert records[0] == records[1]      # bit-identical seeded search
+
+
+def test_search_fake_oom_marked_infeasible():
+    spec, config = _mlp_fixture()
+
+    def oom_hook(plan):
+        if plan.remat:                   # chaos: every remat plan "OOMs"
+            raise RuntimeError("RESOURCE_EXHAUSTED: fake out of memory")
+
+    # uncapped over a restricted space so remat plans reach the trials
+    # (the analytic rank puts them last — a cap would drop them)
+    result = run_search(spec, config, measure=_fake_measure,
+                        oom_hook=oom_hook, max_trials=None,
+                        space_options=dict(zero_options=("none",),
+                                           compress_options=("none",),
+                                           grad_accum_options=(1,)))
+    oomed = [t for t in result.trials if t.infeasible]
+    assert result.n_infeasible == len(oomed) > 0
+    assert all(t.oom and "RESOURCE_EXHAUSTED" in t.error for t in oomed)
+    assert not result.best.remat         # winner comes from the survivors
+    assert result.best_sps > 0
+
+
+def test_search_all_pruned_raises():
+    spec, config = _mlp_fixture()
+    with pytest.raises(ValueError, match="pruned all"):
+        run_search(spec, config, measure=_fake_measure, budget_bytes=1)
+
+
+def test_search_all_infeasible_raises():
+    spec, config = _mlp_fixture()
+
+    def oom_hook(plan):
+        raise RuntimeError("RESOURCE_EXHAUSTED: fake")
+
+    with pytest.raises(RuntimeError, match="no plan survived"):
+        run_search(spec, config, measure=_fake_measure, oom_hook=oom_hook,
+                   max_trials=4)
+
+
+def test_trial_harness_measure_shortcut():
+    spec, config = _mlp_fixture()
+    import jax
+    dataset = spec.build_dataset(config)
+    h = TrialHarness(spec, config, dataset, jax.devices(),
+                     measure=lambda p, s: 42.0)
+    r = h.run(Plan(mesh=(("data", 8),)), steps=3)
+    assert r.steps_per_sec == 42.0 and r.measured_steps == 3
+    assert r.examples_per_sec == 42.0 * config.batch_size
+    assert not r.infeasible
+    det = r.to_dict(deterministic_only=True)
+    assert "compile_seconds" not in det and "cost" not in det
+
+
+def test_autotune_cli_dry_run_smoke():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "scripts/autotune.py", "mlp", "--dry-run",
+         "-b", "32"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["dry_run"] and rec["workload"] == "mlp"
+    assert rec["n_candidates"] > 0
+    assert rec["n_feasible"] + rec["n_pruned_analytic"] == rec["n_candidates"]
+
+
+# ------------------------------------------------ measured trials (slow)
+
+@pytest.mark.slow
+def test_real_search_mlp_best_at_least_baseline():
+    spec, config = _mlp_fixture()
+    result = run_search(
+        spec, config, trial_steps=2, max_trials=4,
+        space_options=dict(zero_options=("none",),
+                           compress_options=("none",),
+                           grad_accum_options=(1,)))
+    assert result.best_sps >= result.baseline_sps > 0
+    best_trial = next(t for t in result.trials if t.plan == result.best)
+    assert best_trial.cost, "compiled trial must record cost_analysis"
+
+
+@pytest.mark.slow
+def test_autotune_then_plan_replay_bit_identical(tmp_path, monkeypatch):
+    monkeypatch.setenv("DDL_DATA_LIMIT", "512")
+    path = str(tmp_path / "mlp.plan.json")
+    argv = ["-e", "1", "-b", "32", "-m", "data"]
+    cfg1 = parse_args(argv + ["--autotune", "--plan", path], workload="mlp")
+    _, hist1 = run_workload(get_spec("mlp"), cfg1)
+    assert os.path.exists(path)
+
+    cfg2 = parse_args(argv + ["--plan", path], workload="mlp")
+    _, hist2 = run_workload(get_spec("mlp"), cfg2)
+    # the replayed run IS the searched plan's run: bit-identical training
+    assert hist1[-1].loss == hist2[-1].loss
+    assert hist1[-1].accuracy == hist2[-1].accuracy
+
+    # and the artifact round-trips to the exact trial config (hash match)
+    plan, record = load_plan(path)
+    assert record["plan_hash"] == plan_hash(plan)
+    assert plan_from_config(apply_plan(cfg2, plan), 8) == plan
