@@ -481,6 +481,69 @@ def _autotune() -> dict | None:
     }
 
 
+def _reshard() -> dict | None:
+    """Cross-topology reshard (ISSUE 6): redistribution bandwidth for the
+    two paths — host-gather fallback vs chunked per-shard streaming — on
+    a checkpoint-sized array moved across a REAL mesh change (N → N-2
+    devices: 8→6 on the CI box, a non-power-of-2 target), plus the full
+    shrink drill (kill 2, re-plan via tune/, reshard-restore, continue)
+    timed end to end.  CPU-measurable (redistribution is slicing +
+    device_put logic); the TPU-shaped harvest lives in
+    ``scripts/tpu_validation.py``'s ``reshard`` section."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_deep_learning_tpu.reshard.redistribute import (
+        redistribute_leaf)
+    from distributed_deep_learning_tpu.runtime.mesh import build_mesh
+
+    devices = jax.devices()
+    n = len(devices)
+    m = n - 2 if n > 2 else 1
+    mb = int(os.environ.get("BENCH_RESHARD_MB", 64))
+    cols = 1024
+    quantum = math.lcm(n, m)  # rows divide both source and target meshes
+    rows = max(quantum,
+               (mb * (1 << 20) // (4 * cols)) // quantum * quantum)
+    host = np.random.default_rng(11).standard_normal(
+        (rows, cols)).astype(np.float32)
+    src = jax.device_put(jnp.asarray(host),
+                         NamedSharding(build_mesh({"data": n}, devices),
+                                       P("data")))
+    dst = NamedSharding(build_mesh({"data": m}, devices[:m]), P("data"))
+    gb = host.nbytes / (1 << 30)
+
+    out: dict = {
+        "metric": "cross-topology reshard (redistribution + shrink drill)",
+        "array_mb": round(host.nbytes / (1 << 20), 1),
+        "devices": f"{n}->{m}"}
+    for method in ("gather", "chunked"):
+        moved, _ = redistribute_leaf(src, dst, method=method)  # warm path
+        jax.block_until_ready(moved)
+        t0 = time.perf_counter()
+        moved, _ = redistribute_leaf(src, dst, method=method)
+        jax.block_until_ready(moved)
+        dt = time.perf_counter() - t0
+        out[f"{method}_seconds_per_gb"] = round(dt / gb, 4)
+        out[f"{method}_gb_per_sec"] = round(gb / dt, 3)
+
+    if n >= 8:
+        from distributed_deep_learning_tpu.reshard.drill import (
+            run_shrink_drill)
+
+        drill = run_shrink_drill(
+            seed=int(os.environ.get("BENCH_CHAOS_SEED", "0")),
+            hidden=128, rows=512, min_leaf_size=2 ** 10)
+        out["drill"] = {k: drill[k] for k in
+                       ("plan", "plan_hash", "survivors", "restore_mode",
+                        "restore_seconds", "drill_passed")}
+    return out
+
+
 def _attention_speedup(steps: int = 20) -> float | None:
     """Fused (Pallas flash) vs dense attention fwd+bwd at a long-context
     shape; returns flash/dense step-time ratio > 1 = flash faster.  TPU
@@ -807,6 +870,25 @@ def main() -> None:
             print(f"bench: autotune section failed "
                   f"({type(exc).__name__}: {exc})", file=sys.stderr)
 
+    # --- reshard: cross-topology redistribution + shrink drill --------------
+    reshard = None
+    t_reshard = 90 if on_tpu else 60
+    if os.environ.get("BENCH_RESHARD", "1") != "0" and \
+            _time_left() < t_reshard:
+        print(f"bench: shedding reshard section ({_time_left():.0f}s left)",
+              file=sys.stderr)
+    elif os.environ.get("BENCH_RESHARD", "1") != "0":
+        try:
+            with _section_timer("reshard"):
+                reshard = _reshard()
+            rvs = _vs_baseline(baselines,
+                               f"{platform}:reshard_chunked_gb_per_sec_v1",
+                               reshard["chunked_gb_per_sec"], base_path)
+            reshard["vs_baseline"] = round(rvs, 4)
+        except Exception as exc:
+            print(f"bench: reshard section failed "
+                  f"({type(exc).__name__}: {exc})", file=sys.stderr)
+
     attn_speedup = None
     if on_tpu and os.environ.get("BENCH_ATTENTION", "1") != "0":
         if _time_left() < 90:
@@ -838,6 +920,7 @@ def main() -> None:
         "serving": serving,
         "resilience": resilience,
         "autotune": autotune,
+        "reshard": reshard,
         "flash_attention_speedup":
             round(attn_speedup, 3) if attn_speedup else None,
         "section_secs": section_secs,
@@ -946,7 +1029,7 @@ def orchestrate() -> int:
     # set can never fit, but headline-only with a warm compile cache can).
     shed = {"BENCH_SECONDARY": "0", "BENCH_LM": "0", "BENCH_INPUT": "0",
             "BENCH_ATTENTION": "0", "BENCH_SERVE": "0",
-            "BENCH_RESILIENCE": "0"}
+            "BENCH_RESILIENCE": "0", "BENCH_RESHARD": "0"}
     plan: list[dict] = [{}] if pinned else [
         {"BENCH_BATCH_PER_CHIP": "256"},
         {"BENCH_BATCH_PER_CHIP": "128", **shed},

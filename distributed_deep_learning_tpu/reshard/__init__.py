@@ -1,0 +1,35 @@
+"""Cross-topology elastic resume: restore any checkpoint onto any mesh.
+
+Production pods shrink and grow; a checkpoint locked to the topology that
+wrote it strands the job until a same-shape spare appears.  This package
+turns checkpoints into portable artifacts in three pieces:
+
+* :mod:`.manifest` — a **topology manifest** (mesh axes/shape, per-leaf
+  PartitionSpec, device count, format version) written into the integrity
+  sidecar of every save (:mod:`..utils.checkpoint`), so a restore can tell
+  *how* the bytes were laid out, not just that they are intact.
+* :mod:`.redistribute` — a **portable redistribution layer** mapping each
+  leaf from source sharding to target sharding: a host-gather fallback
+  that always works, and a chunked path that streams per-shard slices so
+  no single host ever materialises the full array (the collective-
+  decomposition idiom of arxiv 2112.01075, over the GSPMD sharded-
+  checkpoint model of arxiv 2204.06514).
+* :mod:`.replan` + :mod:`.restore` — the **re-plan-then-reshard restore
+  path**: on elastic restart with a different surviving topology, the
+  ``tune/`` planner (analytic memory model, optional quick trials) picks a
+  legal plan for the new device count, ``derive_state_spec`` builds the
+  new state spec, and the resharding restore places the verified
+  checkpoint into it.
+
+:mod:`.drill` proves the chain end to end: kill K of N workers, re-plan,
+reshard, continue — params allclose to a same-topology restore, no human.
+"""
+
+from distributed_deep_learning_tpu.reshard.manifest import (  # noqa: F401
+    TOPOLOGY_FORMAT, Topology, capture, of_placement, same_topology)
+from distributed_deep_learning_tpu.reshard.redistribute import (  # noqa: F401
+    RedistributeStats, redistribute, redistribute_leaf, tree_shardings)
+from distributed_deep_learning_tpu.reshard.replan import (  # noqa: F401
+    choose_plan, latest_topology, replan_config, resolve_restart_topology)
+from distributed_deep_learning_tpu.reshard.restore import (  # noqa: F401
+    ReshardGeometryError, make_restore_fn, restore_resharded)

@@ -1,0 +1,172 @@
+"""Topology manifest: how a checkpoint's bytes were laid out across devices.
+
+The integrity sidecar (:mod:`..utils.checkpoint`) already records *what* was
+saved — per-leaf CRC32, shape, dtype, finiteness.  This module records *how*:
+the mesh axis names and sizes, the device count, and every leaf's
+``PartitionSpec``, as a plain-JSON block embedded in the same sidecar.  A
+restore on a different topology reads it to decide whether the checkpoint
+can be taken as-is (same topology), must be resharded (different topology),
+or predates topology manifests entirely (legacy — assume same topology,
+warn, never quarantine).
+
+Everything here is metadata-only: :func:`capture` walks a pytree's sharding
+attributes without touching array bytes, so writing the manifest costs
+microseconds regardless of model size.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+TOPOLOGY_FORMAT = 1
+
+
+def _canonical_entries(spec) -> tuple:
+    """A PartitionSpec's entries in canonical form: tuples for multi-axis
+    entries, trailing ``None`` padding stripped (``P("data", None)`` and
+    ``P("data")`` describe the same placement but differ as raw tuples)."""
+    out = [tuple(e) if isinstance(e, (list, tuple)) else e for e in spec]
+    while out and out[-1] is None:
+        out.pop()
+    return tuple(out)
+
+
+def spec_to_json(spec) -> list:
+    """PartitionSpec -> JSON-safe entry list (axis name, axis-name list, or
+    null for an unsharded dimension)."""
+    return [list(e) if isinstance(e, tuple) else e
+            for e in _canonical_entries(spec)]
+
+
+def spec_from_json(entries) -> Any:
+    """Inverse of :func:`spec_to_json`."""
+    from jax.sharding import PartitionSpec as P
+
+    return P(*[tuple(e) if isinstance(e, list) else e
+               for e in (entries or [])])
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """The placement fingerprint of one saved state.
+
+    ``mesh_shape`` keeps every axis (including size-1 ones) in mesh order so
+    the manifest is a faithful record; comparisons normalise size-1 axes
+    away — a ``data=8`` mesh and a ``data=8, fsdp=1`` mesh place bytes
+    identically.  ``leaf_specs`` maps ``jax.tree_util.keystr`` paths (the
+    same keys as the integrity manifest's ``leaves``) to canonical
+    PartitionSpec entry tuples.
+    """
+
+    mesh_shape: tuple[tuple[str, int], ...]
+    n_devices: int
+    leaf_specs: dict[str, tuple]
+
+    def mesh_dict(self) -> dict[str, int]:
+        return dict(self.mesh_shape)
+
+    def normalized_mesh(self) -> tuple[tuple[str, int], ...]:
+        out = tuple((a, s) for a, s in self.mesh_shape if s != 1)
+        return out if out else (("data", 1),)
+
+    def describe(self) -> str:
+        mesh = ",".join(f"{a}={s}" for a, s in self.normalized_mesh())
+        return f"mesh[{mesh}]x{self.n_devices}dev"
+
+    def to_json(self) -> dict:
+        return {
+            "format": TOPOLOGY_FORMAT,
+            "mesh": {a: s for a, s in self.mesh_shape},
+            "n_devices": self.n_devices,
+            "leaf_specs": {k: [list(e) if isinstance(e, tuple) else e
+                               for e in v]
+                           for k, v in self.leaf_specs.items()},
+        }
+
+    @staticmethod
+    def from_json(payload) -> "Topology | None":
+        """Parse a manifest's ``topology`` block; ``None`` for anything
+        missing or malformed (the caller treats that as legacy)."""
+        try:
+            mesh = tuple((str(a), int(s))
+                         for a, s in payload["mesh"].items())
+            specs = {str(k): _canonical_entries(spec_from_json(v))
+                     for k, v in payload.get("leaf_specs", {}).items()}
+            return Topology(mesh_shape=mesh,
+                            n_devices=int(payload["n_devices"]),
+                            leaf_specs=specs)
+        except (TypeError, KeyError, ValueError, AttributeError):
+            return None
+
+
+def same_topology(a: Topology | None, b: Topology | None) -> bool:
+    """True when two topologies place bytes identically: same device count,
+    same non-trivial mesh axes, same per-leaf specs."""
+    if a is None or b is None:
+        return False
+    return (a.n_devices == b.n_devices
+            and a.normalized_mesh() == b.normalized_mesh()
+            and a.leaf_specs == b.leaf_specs)
+
+
+def _mesh_of(sharding) -> tuple[tuple[tuple[str, int], ...], int] | None:
+    import jax
+
+    if isinstance(sharding, jax.sharding.NamedSharding):
+        shape = tuple((str(a), int(s)) for a, s in sharding.mesh.shape.items())
+        return shape, int(sharding.mesh.devices.size)
+    return None
+
+
+def capture(tree) -> Topology:
+    """Fingerprint a *placed* pytree (the ``_as_pytree`` view of a
+    TrainState): mesh from the first NamedSharding leaf, per-leaf specs
+    keyed exactly like the integrity manifest.  Leaves without a
+    NamedSharding (host scalars, single-device runs) record as fully
+    replicated ``P()``."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    mesh_shape, n_devices = None, None
+    leaf_specs: dict[str, tuple] = {}
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        sharding = getattr(leaf, "sharding", None)
+        found = _mesh_of(sharding)
+        if found is not None:
+            leaf_specs[key] = _canonical_entries(sharding.spec)
+            if mesh_shape is None:
+                mesh_shape, n_devices = found
+        else:
+            leaf_specs[key] = _canonical_entries(P())
+    if mesh_shape is None:
+        mesh_shape, n_devices = (("data", 1),), 1
+    if not n_devices:  # pragma: no cover - defensive
+        n_devices = max(1, math.prod(s for _, s in mesh_shape))
+    return Topology(mesh_shape=mesh_shape, n_devices=n_devices,
+                    leaf_specs=leaf_specs)
+
+
+def of_placement(mesh, shardings_tree) -> Topology:
+    """Fingerprint a *target* placement: a pytree of shardings (shaped like
+    the state's ``_as_pytree`` view) on ``mesh``.  This is what the restore
+    path compares a saved :class:`Topology` against."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        shardings_tree, is_leaf=lambda x: isinstance(x, jax.sharding.Sharding))
+    leaf_specs = {}
+    for path, sharding in flat:
+        key = jax.tree_util.keystr(path)
+        if isinstance(sharding, jax.sharding.NamedSharding):
+            leaf_specs[key] = _canonical_entries(sharding.spec)
+        else:
+            leaf_specs[key] = _canonical_entries(P())
+    mesh_shape = tuple((str(a), int(s)) for a, s in mesh.shape.items())
+    return Topology(mesh_shape=mesh_shape,
+                    n_devices=int(mesh.devices.size),
+                    leaf_specs=leaf_specs)

@@ -1,0 +1,178 @@
+"""The resharding restore: verified checkpoint -> any target placement.
+
+Decision tree per candidate step (newest first, same fallback-and-
+quarantine chain as ``Checkpointer.restore_verified``):
+
+* no topology manifest -> **legacy**: warn, restore as same-topology,
+  never quarantine (pre-reshard run directories stay resumable);
+* saved topology == target topology -> plain verified restore;
+* different topology -> **chunked** restore (orbax reads only the slices
+  each target shard needs, straight from disk) with a **host-gather**
+  fallback (restore fully replicated on the new mesh, then redistribute
+  each leaf onto its target sharding) when the backend cannot do sliced
+  reads.
+
+A *geometry* mismatch (the checkpoint's leaf shapes/dtypes don't match
+the target state — wrong model, not wrong mesh) raises
+:class:`ReshardGeometryError` immediately instead of quarantining: the
+checkpoint is fine, the request is wrong.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from distributed_deep_learning_tpu.reshard import manifest as _manifest
+from distributed_deep_learning_tpu.reshard.redistribute import (
+    redistribute, tree_shardings)
+from distributed_deep_learning_tpu.utils.checkpoint import (
+    CheckpointCorruption, _as_pytree, _with_fields)
+
+
+class ReshardGeometryError(RuntimeError):
+    """The checkpoint's leaf geometry doesn't match the restore target —
+    a model mismatch, not a topology mismatch; nothing is quarantined."""
+
+
+def _check_geometry(ckpt, step: int, target_tree) -> None:
+    """Compare the integrity manifest's per-leaf shape/dtype against the
+    target's.  Only leaves the manifest recorded fully (single-host CRC
+    records) are checked; a legacy manifest checks nothing."""
+    import jax
+
+    record = ckpt.read_manifest(step) or {}
+    saved = record.get("leaves") or {}
+    if not saved:
+        return
+    flat, _ = jax.tree_util.tree_flatten_with_path(target_tree)
+    actual = {jax.tree_util.keystr(p): leaf for p, leaf in flat}
+    bad = []
+    for key, rec in saved.items():
+        if rec.get("crc32") is None or "shape" not in rec:
+            continue
+        leaf = actual.get(key)
+        if leaf is None:
+            bad.append(f"{key} missing from target")
+            continue
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if tuple(rec["shape"]) != shape:
+            bad.append(f"{key}: saved {tuple(rec['shape'])} vs "
+                       f"target {shape}")
+    if bad:
+        raise ReshardGeometryError(
+            f"checkpoint step {step} cannot reshard onto this state — "
+            f"leaf geometry differs ({'; '.join(bad[:4])}"
+            f"{'; ...' if len(bad) > 4 else ''})")
+
+
+def restore_resharded(ckpt, target, *, mesh, state_spec, step=None,
+                      method: str = "auto", logger=None):
+    """Restore the newest usable checkpoint at/below ``step`` into
+    ``target`` placed per ``state_spec`` on ``mesh``.
+
+    Returns ``(state, step, info)`` — or ``(None, None, info)`` when no
+    checkpoint survives (caller starts fresh).  ``info['mode']`` is one of
+    ``legacy | same | chunked | gather``; cross-topology restores also
+    carry source/target descriptions and timing.  ``method`` forces a
+    redistribution path (``chunked``/``gather``); ``auto`` tries chunked
+    and falls back.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    def log(msg: str) -> None:
+        if logger is not None:
+            logger.info(msg)
+        else:
+            print(msg, file=sys.stderr, flush=True)
+
+    ckpt.wait_until_finished()
+    target_tree = _as_pytree(target)
+    shardings = tree_shardings(mesh, state_spec, target_tree)
+    current = _manifest.of_placement(mesh, shardings)
+    info: dict = {"mode": None}
+
+    candidates = sorted(ckpt.all_steps(), reverse=True)
+    if step is not None:
+        candidates = [s for s in candidates if s <= step]
+    for s in candidates:
+        topo = ckpt.read_topology(s)
+        if topo is not None:
+            # fail fast on a model mismatch — NOT a quarantine offence
+            _check_geometry(ckpt, s, target_tree)
+        try:
+            if topo is None:
+                log(f"reshard: checkpoint step {s} has no topology "
+                    "manifest (pre-reshard save); restoring as "
+                    "same-topology (legacy)")
+                return ckpt.restore(target, step=s, verify=True), s, \
+                    {"mode": "legacy"}
+            if _manifest.same_topology(topo, current):
+                return ckpt.restore(target, step=s, verify=True), s, \
+                    {"mode": "same"}
+
+            info = {"mode": None, "source": topo.describe(),
+                    "target": current.describe()}
+            start = time.perf_counter()
+            restored = None
+            if method in ("auto", "chunked"):
+                try:
+                    restored = ckpt.restore(target, step=s, verify=True,
+                                            shardings=shardings)
+                    info["mode"] = "chunked"
+                except CheckpointCorruption:
+                    raise  # real corruption: quarantine-and-fall-back
+                except Exception as exc:
+                    if method == "chunked":
+                        raise
+                    log("reshard: sliced on-disk restore unavailable "
+                        f"({type(exc).__name__}: {exc}); "
+                        "host-gather fallback")
+            if restored is None:
+                # gather path: pull the step fully replicated onto the
+                # new mesh, then redistribute leaf by leaf
+                replicated = jax.tree.map(
+                    lambda _: NamedSharding(mesh, P()), shardings,
+                    is_leaf=lambda x: isinstance(x, jax.sharding.Sharding))
+                gathered = ckpt.restore(target, step=s, verify=True,
+                                        shardings=replicated)
+                moved, stats = redistribute(_as_pytree(gathered), shardings,
+                                            method="gather")
+                restored = _with_fields(target, moved)
+                info["mode"] = "gather"
+                info["redistribute"] = stats.to_dict()
+            info["seconds"] = round(time.perf_counter() - start, 4)
+            log(f"reshard: restored step {s} across topologies "
+                f"[{info['source']} -> {info['target']}] via "
+                f"{info['mode']} in {info['seconds']}s")
+            return restored, s, info
+        except ReshardGeometryError:
+            raise
+        except Exception as exc:
+            print(f"reshard: step {s} unusable "
+                  f"({type(exc).__name__}: {exc}); quarantining and "
+                  "falling back", file=sys.stderr, flush=True)
+            ckpt.quarantine(s, reason=f"{type(exc).__name__}: {exc}")
+    return None, None, {"mode": None}
+
+
+def make_restore_fn(ckpt, mesh, state_spec, *, method: str = "auto",
+                    logger=None):
+    """A drop-in replacement for ``Checkpointer.restore_verified`` bound
+    to a target placement — the hook ``fit_with_recovery`` calls on every
+    (re)start, so elastic restarts reshard transparently."""
+
+    def restore_fn(target, step=None):
+        state, used, info = restore_resharded(
+            ckpt, target, mesh=mesh, state_spec=state_spec, step=step,
+            method=method, logger=logger)
+        restore_fn.last_info = info
+        return state, used
+
+    restore_fn.last_info = {}
+    return restore_fn
+
+
+__all__ = ["ReshardGeometryError", "restore_resharded", "make_restore_fn"]

@@ -81,7 +81,7 @@ def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
                       monitor: FailureMonitor | None = None,
                       max_restarts: int = 2,
                       checkpoint_every: int | None = None,
-                      sentinel=None, chaos=None
+                      sentinel=None, chaos=None, restore_fn=None
                       ) -> tuple[Any, list[EpochResult]]:
     """Run :func:`..loop.fit` with checkpointed restart on failure.
 
@@ -110,6 +110,12 @@ def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
       between attempts, so a recorded failure from the dead attempt does
       not permanently poison the retries (the replacement worker is
       expected to heartbeat again).
+    * ``restore_fn`` swaps the restore implementation — same contract as
+      ``restore_verified`` (``(target, step=None) -> (state, step)``).
+      The cross-topology resume path (:mod:`..reshard`) passes
+      :func:`..reshard.restore.make_restore_fn` here so a restart on a
+      different surviving mesh reshards the checkpoint transparently;
+      every quarantine/fallback guarantee above still holds.
     """
     logger = logger or PhaseLogger(verbose=False)
     train_loader, val_loader, test_loader = loaders
@@ -124,7 +130,8 @@ def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
         # resume point: a step save scheduled just before the failure must
         # be visible to this retry, or it would resume from an older
         # boundary and try to re-save an id that then finalises under it
-        restored, ckpt_step = checkpointer.restore_verified(state)
+        restored, ckpt_step = (restore_fn or
+                               checkpointer.restore_verified)(state)
         if ckpt_step is not None:
             state = restored
             _, start_epoch, resume_batch, resume_totals = \

@@ -38,7 +38,8 @@ import time
 import numpy as np
 
 KINDS = ("nan_batch", "grad_spike", "worker_failure", "stale_heartbeat")
-INJECTOR_KINDS = ("ckpt_truncate", "ckpt_bitflip", "fs_error")
+INJECTOR_KINDS = ("ckpt_truncate", "ckpt_bitflip", "fs_error",
+                  "shrink_topology")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -233,6 +234,28 @@ class ChaosPlan:
             return real()
 
         monitor.check = check
+
+    @staticmethod
+    def shrink_topology(devices, kill: int = 2,
+                        seed: int = 0) -> tuple[list, list[int]]:
+        """The pod-shrink drill: seed-pick `kill` devices to "lose" and
+        return ``(survivors, dead_indices)``.
+
+        Like every injector here it is a pure function of its seed —
+        ``(seed, n_devices, kill)`` keys the rng — so a drill replays
+        bit-identically: same seed, same dead workers, same surviving
+        mesh, same re-plan.  One-shot by construction (the caller builds
+        the new mesh from ``survivors`` exactly once)."""
+        devices = list(devices)
+        if not 0 < kill < len(devices):
+            raise ValueError(
+                f"shrink_topology: kill must be in (0, {len(devices)}), "
+                f"got {kill}")
+        rng = np.random.default_rng((seed, len(devices), kill))
+        dead = set(rng.choice(len(devices), size=kill,
+                              replace=False).tolist())
+        survivors = [d for i, d in enumerate(devices) if i not in dead]
+        return survivors, sorted(dead)
 
 
 # ---------------------------------------------------------------------------

@@ -42,7 +42,11 @@ from distributed_deep_learning_tpu.train.state import TrainState
 # staged trainer's StagedState)
 _FIELDS = ("step", "params", "model_state", "opt_state")
 
-MANIFEST_FORMAT = 1
+# Format 2 adds the topology block (mesh shape + per-leaf PartitionSpec,
+# see reshard/manifest.py).  Readers treat a missing block — format 1 or
+# any pre-integrity checkpoint — as legacy-same-topology: warn, restore,
+# never quarantine, so every pre-reshard run directory stays resumable.
+MANIFEST_FORMAT = 2
 
 
 class CheckpointCorruption(RuntimeError):
@@ -147,12 +151,18 @@ class Checkpointer:
         if extra is not None and jax.process_index() == 0:
             self._write_json(self._extra_path(step), extra)
         if manifest and jax.process_index() == 0:
-            records = _leaf_records(_as_pytree(state))
+            from distributed_deep_learning_tpu.reshard.manifest import capture
+
+            tree = _as_pytree(state)
+            records = _leaf_records(tree)
             self._write_json(self._manifest_path(step), {
                 "format": MANIFEST_FORMAT,
                 "all_finite": all(r.get("finite", True)
                                   for r in records.values()),
                 "leaves": records,
+                # metadata-only placement fingerprint: lets a restore on a
+                # different topology know it must reshard
+                "topology": capture(tree).to_json(),
             })
         saved = self._mgr.save(
             step, args=ocp.args.StandardSave(_as_pytree(state)), force=force)
@@ -219,12 +229,40 @@ class Checkpointer:
         except (FileNotFoundError, json.JSONDecodeError):
             return None
 
+    def read_manifest(self, step: int | None = None) -> dict | None:
+        """The integrity manifest sidecar for `step` (default: latest), or
+        None (legacy checkpoint / unreadable sidecar)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        try:
+            with open(self._manifest_path(step)) as f:
+                return json.load(f)
+        except (FileNotFoundError, OSError, json.JSONDecodeError):
+            return None
+
+    def read_topology(self, step: int | None = None):
+        """The saved :class:`~...reshard.manifest.Topology` for `step`, or
+        None for a legacy checkpoint (format-1 manifest, no manifest at
+        all, or a malformed block) — callers treat None as "same topology
+        as the writer", warn, and never quarantine."""
+        from distributed_deep_learning_tpu.reshard.manifest import Topology
+
+        manifest = self.read_manifest(step)
+        if not manifest:
+            return None
+        return Topology.from_json(manifest.get("topology"))
+
     # -- restore ------------------------------------------------------------
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
+    def all_steps(self) -> list[int]:
+        """Finalised step ids, ascending."""
+        return sorted(self._mgr.all_steps())
+
     def restore(self, target: TrainState, step: int | None = None, *,
-                verify: bool = True) -> TrainState | None:
+                verify: bool = True, shardings=None) -> TrainState | None:
         """Restore into the structure/shardings of `target`.
 
         Returns None when the directory holds no checkpoint (caller starts
@@ -238,6 +276,13 @@ class Checkpointer:
         saved without a manifest (pre-integrity run dirs) restore
         unverified.  Use :meth:`restore_verified` for the full
         fallback-and-quarantine recovery path.
+
+        ``shardings`` (a pytree of per-leaf Shardings shaped like the
+        saved fields) overrides the abstract target's placement: orbax
+        then reads only the slices each target shard needs — the on-disk
+        chunked half of cross-topology resume (reshard/).  Verification
+        still applies: the CRC is over the global array, placement-
+        independent.
         """
         step = self.latest_step() if step is None else step
         if step is None:
@@ -245,10 +290,17 @@ class Checkpointer:
         # abstract target: arrays → ShapeDtypeStruct carrying their sharding
         # (so each host restores its addressable shards); python scalars
         # (e.g. a plain int step) pass through as-is
-        abstract = jax.tree.map(
-            lambda x: ocp.utils.to_shape_dtype_struct(x)
-            if isinstance(x, jax.Array) else x,
-            _as_pytree(target))
+        if shardings is None:
+            abstract = jax.tree.map(
+                lambda x: ocp.utils.to_shape_dtype_struct(x)
+                if isinstance(x, jax.Array) else x,
+                _as_pytree(target))
+        else:
+            abstract = jax.tree.map(
+                lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                                  sharding=s)
+                if isinstance(x, jax.Array) else x,
+                _as_pytree(target), shardings)
         restored = self._mgr.restore(
             step, args=ocp.args.StandardRestore(abstract))
         if verify:

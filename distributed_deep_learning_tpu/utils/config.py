@@ -190,6 +190,13 @@ class Config:
     sentinel_window: int = 32           # EMA horizon for spike detection
     sentinel_factor: float = 10.0       # spike threshold (x running mean)
     elastic: bool = False               # checkpointed restart on failure
+    reshard: bool = False               # cross-topology resume: restore a
+                                        #   checkpoint saved on a different
+                                        #   mesh, re-planning via tune/
+                                        #   (reshard/)
+    target_mesh: dict[str, int] | None = None  # --target-mesh: pin the
+                                        #   restart mesh instead of
+                                        #   re-planning
     heartbeat_dir: str | None = None    # shared dir for liveness heartbeats
     heartbeat_timeout: float = 30.0     # seconds before a peer counts as dead
     autotune: bool = False              # search the plan lattice (tune/)
@@ -423,6 +430,15 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
     p.add_argument("--elastic", action="store_true",
                    help="restart from the last checkpoint on worker failure "
                         "or runtime error (requires --checkpoint-dir)")
+    p.add_argument("--reshard", action="store_true",
+                   help="cross-topology resume: restore the checkpoint even "
+                        "if it was saved on a different mesh, re-planning "
+                        "for the surviving devices via tune/ (requires "
+                        "--resume or --elastic)")
+    p.add_argument("--target-mesh", type=str, default=None, metavar="SHAPE",
+                   help="with --reshard: restore onto exactly this mesh "
+                        "(same axis=N syntax as --mesh) instead of "
+                        "re-planning")
     p.add_argument("--heartbeat-dir", type=str, default=None,
                    help="shared directory for liveness heartbeats; with "
                         "--elastic, dead peers abort the step promptly "
@@ -456,13 +472,16 @@ def parse_buckets_arg(text: str | None) -> tuple[int, ...] | None:
     return buckets
 
 
-def parse_mesh_arg(text: str | None) -> dict[str, int] | None:
+def parse_mesh_arg(text: str | None,
+                   flag: str = "--mesh") -> dict[str, int] | None:
     """``--mesh`` string → shape dict, validated at parse time.
 
     A bad mesh string is an argparse-style error naming the known axes —
     not a ``ValueError`` traceback from ``MeshSpec`` deep inside startup.
     The device-count constraint (axis product vs. available devices) is
     checked later by ``MeshSpec.resolve``, which knows the topology.
+    ``flag`` names the offending option in the error (``--target-mesh``
+    reuses this exact validation).
     """
     if not text:
         return None
@@ -471,25 +490,25 @@ def parse_mesh_arg(text: str | None) -> dict[str, int] | None:
         axis, _, n = part.partition("=")
         axis = axis.strip()
         if not n:
-            raise SystemExit(f"--mesh: bad entry {part!r}; expected axis=N "
+            raise SystemExit(f"{flag}: bad entry {part!r}; expected axis=N "
                              f"with axis one of {', '.join(MESH_AXES)}")
         if axis not in MESH_AXES:
-            raise SystemExit(f"--mesh: unknown axis {axis!r}; known axes: "
+            raise SystemExit(f"{flag}: unknown axis {axis!r}; known axes: "
                              f"{', '.join(MESH_AXES)}")
         if axis in shape:
-            raise SystemExit(f"--mesh: axis {axis!r} given twice")
+            raise SystemExit(f"{flag}: axis {axis!r} given twice")
         try:
             size = int(n)
         except ValueError:
-            raise SystemExit(f"--mesh: size for axis {axis!r} must be an "
+            raise SystemExit(f"{flag}: size for axis {axis!r} must be an "
                              f"integer (-1 = fill remaining devices), got "
                              f"{n.strip()!r}") from None
         if size == 0 or size < -1:
-            raise SystemExit(f"--mesh: size for axis {axis!r} must be >= 1 "
+            raise SystemExit(f"{flag}: size for axis {axis!r} must be >= 1 "
                              "(or -1 to fill with the remaining devices)")
         shape[axis] = size
     if sum(1 for v in shape.values() if v == -1) > 1:
-        raise SystemExit("--mesh: at most one axis may be -1")
+        raise SystemExit(f"{flag}: at most one axis may be -1")
     return shape
 
 
@@ -517,6 +536,17 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
                                    or args.sentinel_factor <= 1.0):
         raise SystemExit("--sentinel-window must be >= 1 and "
                          "--sentinel-factor > 1")
+    if args.reshard and not (args.resume or args.elastic):
+        raise SystemExit("--reshard requires --resume or --elastic (it "
+                         "changes how an existing checkpoint is restored; "
+                         "a fresh run has nothing to reshard)")
+    if args.reshard and not args.checkpoint_dir:
+        raise SystemExit("--reshard requires --checkpoint-dir (the "
+                         "topology manifest lives next to the checkpoint)")
+    if args.target_mesh and not args.reshard:
+        raise SystemExit("--target-mesh requires --reshard (without the "
+                         "resharding restore a mesh change would restore "
+                         "garbage; use --mesh to shape a fresh run)")
     mesh_shape = parse_mesh_arg(args.mesh)
     if mesh_shape and args.nstages and \
             mesh_shape.get("stage", args.nstages) != args.nstages:
@@ -577,6 +607,8 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         sentinel_window=args.sentinel_window,
         sentinel_factor=args.sentinel_factor,
         elastic=args.elastic,
+        reshard=args.reshard,
+        target_mesh=parse_mesh_arg(args.target_mesh, flag="--target-mesh"),
         heartbeat_dir=args.heartbeat_dir,
         heartbeat_timeout=args.heartbeat_timeout,
         autotune=args.autotune,

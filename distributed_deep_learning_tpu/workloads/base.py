@@ -341,16 +341,19 @@ def _maybe_checkpointer(config: Config):
 
 
 def _restore_resume(ckpt, state, ckpt_step, start_epoch, resume_batch,
-                    resume_totals, logger):
+                    resume_totals, logger, restore_fn=None):
     """Verified restore for non-elastic ``--resume``.
 
     Integrity fallback: when the requested step is torn/corrupt it is
     quarantined and the newest verified-good step restores instead — the
     resume point is then re-decoded from the step ACTUALLY restored, so
-    the loader replay and phase totals stay consistent with the params."""
+    the loader replay and phase totals stay consistent with the params.
+    ``restore_fn`` (same contract as ``restore_verified``) swaps in the
+    resharding restore under ``--reshard``."""
     from distributed_deep_learning_tpu.train.elastic import resume_point
 
-    restored, used = ckpt.restore_verified(state, step=ckpt_step)
+    restored, used = (restore_fn or ckpt.restore_verified)(state,
+                                                           step=ckpt_step)
     if used is None:
         logger.info("checkpoint integrity: no verifiable checkpoint "
                     "survives; starting fresh")
@@ -471,7 +474,7 @@ def _sentinel_config(config: Config):
 
 
 def _fit_elastic(config: Config, logger, make_state, train_step, eval_step,
-                 loaders, ckpt, sentinel=None):
+                 loaders, ckpt, sentinel=None, restore_fn=None):
     """``--elastic``: checkpointed restart on worker failure or runtime
     error, with optional heartbeat-based liveness detection
     (``--heartbeat-dir``) polled before every step."""
@@ -497,7 +500,8 @@ def _fit_elastic(config: Config, logger, make_state, train_step, eval_step,
                                      checkpointer=ckpt, logger=logger,
                                      monitor=monitor,
                                      checkpoint_every=config.checkpoint_every,
-                                     sentinel=sentinel)
+                                     sentinel=sentinel,
+                                     restore_fn=restore_fn)
     finally:
         if monitor is not None:
             monitor.stop()
@@ -821,6 +825,16 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
                                    splits, example, loss_fn, tx, rng)
 
     if config.mode in (Mode.SEQUENTIAL, Mode.DATA):
+        if config.reshard and config.mode is Mode.DATA:
+            # cross-topology resume: BEFORE any mesh exists, peek the saved
+            # topology manifest and — when it no longer matches the
+            # surviving devices — let tune/ re-plan this restart's mesh
+            # (reshard/replan.py; --target-mesh overrides the search)
+            from distributed_deep_learning_tpu.reshard.replan import (
+                resolve_restart_topology)
+
+            config = resolve_restart_topology(spec, config, devices, logger,
+                                              dataset=dataset)
         if config.mode is Mode.SEQUENTIAL:
             mesh = build_mesh({"data": 1}, devices[:1])
         else:
@@ -875,6 +889,16 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
             config, mesh, loss_fn, state_spec, sentinel=sentinel)
         ckpt, ckpt_step, start_epoch, resume_batch, resume_totals = \
             _maybe_checkpointer(config)
+        restore_fn = None
+        if config.reshard and ckpt is not None:
+            # restores go through the resharding path: same-topology and
+            # legacy checkpoints restore plainly, anything else is
+            # redistributed onto THIS run's mesh/spec
+            from distributed_deep_learning_tpu.reshard.restore import (
+                make_restore_fn)
+
+            restore_fn = make_restore_fn(ckpt, mesh, state_spec,
+                                         logger=logger)
         if config.elastic:
             def make_state():
                 s = create_train_state(model, rng, example, tx,
@@ -887,11 +911,13 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
                 return place_state(s, mesh, state_spec)
 
             return _fit_elastic(config, logger, make_state, train_step,
-                                eval_step, loaders, ckpt, sentinel=sentinel)
+                                eval_step, loaders, ckpt, sentinel=sentinel,
+                                restore_fn=restore_fn)
         if ckpt is not None and ckpt_step is not None:
             state, start_epoch, resume_batch, resume_totals = \
                 _restore_resume(ckpt, state, ckpt_step, start_epoch,
-                                resume_batch, resume_totals, logger)
+                                resume_batch, resume_totals, logger,
+                                restore_fn=restore_fn)
         try:
             with profiling.trace(config.profile_dir):
                 return fit(state, train_step, eval_step, *loaders,

@@ -1,18 +1,29 @@
 """Chaos drill: rehearse the detect→contain→recover chain, print one JSON
 line.
 
-Runs :func:`distributed_deep_learning_tpu.utils.chaos.run_resilience_drill`
-— NaN'd batch contained by the anomaly sentinel (bit-identical params),
-truncated latest checkpoint quarantined with fallback to the verified
-save, injected worker failure recovered by elastic restart — and reports
-detection latency, recovery wall time, restarts used and the sentinel's
-step-time overhead.  CPU-runnable (the chain is host+XLA logic, not
-accelerator-specific); ``bench.py`` embeds the same record as its
-``resilience`` section.
+Two scenarios, selected with ``--scenario``:
+
+* ``resilience`` (default) runs
+  :func:`distributed_deep_learning_tpu.utils.chaos.run_resilience_drill`
+  — NaN'd batch contained by the anomaly sentinel (bit-identical
+  params), truncated latest checkpoint quarantined with fallback to the
+  verified save, injected worker failure recovered by elastic restart —
+  and reports detection latency, recovery wall time, restarts used and
+  the sentinel's step-time overhead.
+* ``shrink`` runs
+  :func:`distributed_deep_learning_tpu.reshard.drill.run_shrink_drill`
+  — seed-kill 2 of the 8 emulated workers, re-plan for the 6 survivors
+  via ``tune/``, reshard-restore the epoch checkpoint onto the new mesh
+  and continue, gating on allclose params/optimizer state and an
+  epoch-2 loss matching the uninterrupted topology's.
+
+Both are CPU-runnable (the chains are host+XLA logic, not
+accelerator-specific); ``bench.py`` embeds the same records as its
+``resilience`` and ``reshard`` sections.
 
 Usage::
 
-    python scripts/chaos_drill.py [--seed N]
+    python scripts/chaos_drill.py [--seed N] [--scenario resilience|shrink]
 """
 
 import argparse
@@ -27,8 +38,20 @@ def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--seed", type=int, default=0,
                    help="chaos plan seed (same seed = same faults, "
-                        "bit-identical poison masks)")
+                        "bit-identical poison masks / kill sets)")
+    p.add_argument("--scenario", choices=("resilience", "shrink"),
+                   default="resilience",
+                   help="resilience: sentinel/corruption/restart chain; "
+                        "shrink: kill workers, re-plan, reshard, continue")
     args = p.parse_args()
+
+    if args.scenario == "shrink":
+        from distributed_deep_learning_tpu.reshard.drill import \
+            run_shrink_drill
+
+        record = run_shrink_drill(seed=args.seed)
+        print(json.dumps(record))
+        return 0 if record["drill_passed"] else 1
 
     from distributed_deep_learning_tpu.utils.chaos import run_resilience_drill
 

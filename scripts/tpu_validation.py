@@ -397,6 +397,22 @@ def autotune(workload="gpt"):
     }
 
 
+def reshard():
+    """Cross-topology reshard on real hardware: redistribution bandwidth
+    for the host-gather and chunked per-shard paths across an N → N-2
+    mesh change, plus the full shrink drill (kill 2, re-plan, reshard,
+    continue).  On TPU this is the first run where the chunked path's
+    point — the host never materialises the full array, and shard slices
+    move at real ICI/PCIe bandwidth — shows up in seconds/GB; the CPU
+    numbers in bench.py only time the slicing logic."""
+    import jax
+
+    from bench import _reshard
+
+    return {"section": "reshard", "on_tpu": jax.default_backend() == "tpu",
+            **(_reshard() or {})}
+
+
 def _record_flash_gate(result: dict) -> None:
     """Persist the measured ratio as the `--attention auto` gate datum."""
     from distributed_deep_learning_tpu.utils.bench_records import (
@@ -407,7 +423,7 @@ def _record_flash_gate(result: dict) -> None:
 
 SECTIONS = ("flash_block_sweep", "flash_vs_dense", "gqa_speedup",
             "s2d_vs_plain", "batch_sweep", "lm_tokens", "serving",
-            "autotune", "mfu_diag", "lm_sweep")
+            "autotune", "reshard", "mfu_diag", "lm_sweep")
 
 
 def _run_section(name: str) -> None:

@@ -1,0 +1,409 @@
+"""Cross-topology elastic resume: restore any checkpoint onto any mesh.
+
+The load-bearing guarantees (ISSUE 6 acceptance):
+
+* redistribution round-trips BIT-EXACT across mesh shapes — shrink
+  (8→4), non-power-of-2 shrink (8→6), reshape (2×4→1×8), replicate→shard
+  (1→N) and shard→replicate (N→1) — for both the host-gather fallback
+  and the chunked per-shard path;
+* the topology manifest (sidecar format 2) captures mesh + per-leaf
+  PartitionSpec at save, round-trips through JSON, and a checkpoint
+  WITHOUT one (pre-reshard run dirs) restores as legacy-same-topology —
+  warned about, never quarantined;
+* the resharding restore inherits every integrity guarantee: a corrupt
+  latest step is quarantined and restore falls back to the previous
+  verified-good save, now on a different mesh;
+* a checkpoint from a DIFFERENT model raises :class:`ReshardGeometryError`
+  naming the mismatched leaves instead of restoring garbage;
+* the full shrink drill (slow): kill 2 of 8, re-plan for 6, reshard,
+  continue with loss allclose to the uninterrupted topology's.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_deep_learning_tpu.models.mlp import MLP
+from distributed_deep_learning_tpu.parallel.zero import zero1_state_spec
+from distributed_deep_learning_tpu.reshard import (
+    ReshardGeometryError, Topology, capture, choose_plan, latest_topology,
+    make_restore_fn, of_placement, redistribute, redistribute_leaf,
+    restore_resharded, same_topology, tree_shardings)
+from distributed_deep_learning_tpu.reshard.manifest import (spec_from_json,
+                                                            spec_to_json)
+from distributed_deep_learning_tpu.runtime.mesh import build_mesh
+from distributed_deep_learning_tpu.train.state import create_train_state
+from distributed_deep_learning_tpu.train.step import place_state
+from distributed_deep_learning_tpu.utils.chaos import ChaosPlan
+from distributed_deep_learning_tpu.utils.checkpoint import (Checkpointer,
+                                                            _as_pytree)
+
+
+def _mesh(shape: dict):
+    n = 1
+    for s in shape.values():
+        n *= s
+    return build_mesh(shape, jax.devices()[:n])
+
+
+def _placed(arr, mesh, spec):
+    return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
+
+
+# --- redistribution round-trips ---------------------------------------------
+
+# (48, 64) divides every axis size used below: 48 % {1,2,4,6,8} == 0 on
+# dim 0, 64 % {4,8} == 0 on dim 1.
+CASES = [
+    ({"data": 8}, P("data"), {"data": 4}, P("data"), "shrink 8->4"),
+    ({"data": 8}, P(None, "data"), {"data": 6}, P("data"),
+     "shrink 8->6 (non-power-of-2, axis moves)"),
+    ({"data": 2, "fsdp": 4}, P("data", "fsdp"), {"data": 8}, P("data"),
+     "reshape 2x4 -> 1x8"),
+    ({"data": 1}, P(), {"data": 8}, P("data"), "replicated -> sharded"),
+    ({"data": 8}, P("data"), {"data": 1}, P(), "sharded -> replicated"),
+]
+
+
+@pytest.mark.parametrize("method", ["gather", "chunked"])
+@pytest.mark.parametrize("src_mesh,src_spec,dst_mesh,dst_spec,name", CASES,
+                         ids=[c[-1] for c in CASES])
+def test_leaf_round_trip_bit_exact(src_mesh, src_spec, dst_mesh, dst_spec,
+                                   name, method):
+    rng = np.random.default_rng(0)
+    host = rng.standard_normal((48, 64)).astype(np.float32)
+    src = _placed(host, _mesh(src_mesh), src_spec)
+    dst_sharding = NamedSharding(_mesh(dst_mesh), dst_spec)
+
+    moved, mode = redistribute_leaf(src, dst_sharding, method=method)
+    assert mode == method
+    assert moved.sharding.is_equivalent_to(dst_sharding, moved.ndim)
+    assert np.array_equal(np.asarray(jax.device_get(moved)), host)
+    # and back again: the reverse move restores the original placement
+    back, _ = redistribute_leaf(moved, src.sharding, method=method)
+    assert np.array_equal(np.asarray(jax.device_get(back)), host)
+
+
+def test_auto_method_picks_by_size(mesh8):
+    mesh4 = _mesh({"data": 4})
+    small = _placed(np.ones((8, 8), np.float32), mesh8, P("data"))
+    big = _placed(np.ones((512, 1024), np.float32), mesh8, P("data"))
+    _, small_mode = redistribute_leaf(small, NamedSharding(mesh4, P("data")))
+    _, big_mode = redistribute_leaf(big, NamedSharding(mesh4, P("data")))
+    assert small_mode == "gather"  # below the chunk threshold
+    assert big_mode == "chunked"   # 2 MiB: streamed per-shard
+
+
+def test_zero_sharded_state_tree_redistributes(mesh8):
+    """The real payload: a ZeRO-1 TrainState whose optimizer moments are
+    sharded DIFFERENTLY on the two meshes (48 % 6 == 0 but the divisible
+    dim changes), moved leaf-wise with allclose values."""
+    mesh6 = _mesh({"data": 6})
+    pristine = jax.device_get(create_train_state(
+        MLP(hidden_size=48), jax.random.key(7), jnp.zeros((1, 48)),
+        optax.adam(1e-3)))
+    spec8 = zero1_state_spec(pristine, mesh8, axis="data",
+                             min_leaf_size=2 ** 6)
+    spec6 = zero1_state_spec(pristine, mesh6, axis="data",
+                             min_leaf_size=2 ** 6)
+    state8 = place_state(pristine, mesh8, spec8)
+
+    tree = _as_pytree(state8)
+    shardings = tree_shardings(mesh6, spec6, tree)
+    moved, stats = redistribute(tree, shardings)
+
+    assert stats.leaves == len(jax.tree.leaves(tree))
+    assert stats.bytes_moved > 0 and stats.seconds >= 0
+    for a, b in zip(jax.tree.leaves(jax.device_get(tree)),
+                    jax.tree.leaves(jax.device_get(moved))):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # every moved leaf really lives on the 6-device mesh now
+    for leaf in jax.tree.leaves(moved):
+        assert len(leaf.sharding.device_set) <= 6
+
+
+# --- topology manifest -------------------------------------------------------
+
+def test_spec_json_round_trip():
+    for spec in (P(), P("data"), P(None, "data"), P(("data", "fsdp")),
+                 P("data", None, "model")):
+        assert spec_from_json(spec_to_json(spec)) == spec
+
+
+def test_topology_capture_and_json_round_trip(mesh8):
+    tree = {"w": _placed(np.ones((48, 8), np.float32), mesh8, P("data")),
+            "b": _placed(np.ones((8,), np.float32), mesh8, P())}
+    topo = capture(tree)
+    assert topo.n_devices == 8
+    assert topo.normalized_mesh() == (("data", 8),)
+    parsed = Topology.from_json(topo.to_json())
+    assert same_topology(topo, parsed)
+    assert "8dev" in topo.describe()
+
+
+def test_topology_from_json_rejects_garbage():
+    assert Topology.from_json(None) is None
+    assert Topology.from_json("not a dict") is None
+    assert Topology.from_json({"mesh": "nope"}) is None
+
+
+def test_same_topology_ignores_size_one_axes(mesh8):
+    sh = {"w": NamedSharding(mesh8, P("data"))}
+    a = of_placement(mesh8, sh)
+    b = of_placement(_mesh({"data": 8}), sh)  # same 8 devices, padded axes
+    assert same_topology(a, b)
+    c = of_placement(_mesh({"data": 4}),
+                     {"w": NamedSharding(_mesh({"data": 4}), P("data"))})
+    assert not same_topology(a, c)
+    assert not same_topology(a, None)
+
+
+def _mlp_state(hidden=48, seed=0):
+    return create_train_state(MLP(hidden_size=hidden), jax.random.key(seed),
+                              jnp.zeros((1, 48)), optax.adam(1e-3))
+
+
+def test_sidecar_carries_topology(tmp_path, mesh8):
+    pristine = jax.device_get(_mlp_state())
+    spec = zero1_state_spec(pristine, mesh8, axis="data", min_leaf_size=2 ** 6)
+    with Checkpointer(tmp_path / "ck") as ck:
+        ck.save(1, place_state(pristine, mesh8, spec), wait=True)
+        manifest = ck.read_manifest(1)
+        assert manifest["format"] == 2
+        topo = ck.read_topology(1)
+    assert topo is not None and topo.n_devices == 8
+    assert topo.normalized_mesh() == (("data", 8),)
+    # at least one leaf is genuinely sharded in the recorded specs
+    assert any(any(e is not None for e in entries)
+               for entries in topo.leaf_specs.values())
+    step, latest = latest_topology(str(tmp_path / "ck"))
+    assert step == 1 and same_topology(topo, latest)
+
+
+# --- resharding restore ------------------------------------------------------
+
+def _kit(mesh, pristine, min_leaf_size=2 ** 6):
+    spec = zero1_state_spec(pristine, mesh, axis="data",
+                            min_leaf_size=min_leaf_size)
+    return spec, place_state(pristine, mesh, spec)
+
+
+def _params_close(a, b, exact=False):
+    cmp = np.array_equal if exact else np.allclose
+    return all(cmp(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree.leaves(jax.device_get(a.params)),
+                               jax.tree.leaves(jax.device_get(b.params))))
+
+
+@pytest.mark.parametrize("method", ["gather", "chunked", "auto"])
+def test_cross_topology_restore(tmp_path, mesh8, method):
+    """8→4: save ZeRO-sharded on the full mesh, restore onto half of it;
+    params AND optimizer moments round-trip bit-exact."""
+    mesh4 = _mesh({"data": 4})
+    pristine = jax.device_get(_mlp_state())
+    spec8, state8 = _kit(mesh8, pristine)
+    spec4, target4 = _kit(mesh4, pristine)
+    with Checkpointer(tmp_path / "ck") as ck:
+        ck.save(1, state8, wait=True)
+        restored, step, info = restore_resharded(
+            ck, target4, mesh=mesh4, state_spec=spec4, method=method)
+    assert step == 1
+    assert info["mode"] in (("chunked", "gather") if method == "auto"
+                            else (method,))
+    assert _params_close(restored, state8, exact=True)
+    for a, b in zip(jax.tree.leaves(jax.device_get(state8.opt_state)),
+                    jax.tree.leaves(jax.device_get(restored.opt_state))):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_same_topology_fast_path(tmp_path, mesh8):
+    """No mesh change → plain verified restore, no redistribution."""
+    pristine = jax.device_get(_mlp_state())
+    spec, state = _kit(mesh8, pristine)
+    with Checkpointer(tmp_path / "ck") as ck:
+        ck.save(1, state, wait=True)
+        restored, step, info = restore_resharded(
+            ck, place_state(pristine, mesh8, spec), mesh=mesh8,
+            state_spec=spec)
+    assert step == 1 and info["mode"] == "same"
+    assert _params_close(restored, state, exact=True)
+
+
+def test_legacy_checkpoint_restores_without_quarantine(tmp_path, mesh8):
+    """A pre-reshard sidecar (format 1, no topology block) restores as
+    legacy-same-topology: warned, restored, never quarantined."""
+    pristine = jax.device_get(_mlp_state())
+    spec, state = _kit(mesh8, pristine)
+    with Checkpointer(tmp_path / "ck") as ck:
+        ck.save(1, state, wait=True)
+        # rewrite the sidecar as a format-1 manifest
+        path = ck._manifest_path(1)
+        with open(path) as f:
+            manifest = json.load(f)
+        manifest.pop("topology")
+        manifest["format"] = 1
+        with open(path, "w") as f:
+            json.dump(manifest, f)
+        assert ck.read_topology(1) is None
+        restore_fn = make_restore_fn(ck, mesh8, spec)
+        restored, step = restore_fn(place_state(pristine, mesh8, spec))
+        assert step == 1 and restore_fn.last_info["mode"] == "legacy"
+        assert _params_close(restored, state, exact=True)
+    assert not os.path.isdir(tmp_path / "ck" / "quarantine")
+
+
+def test_manifestless_checkpoint_restores_as_legacy(tmp_path, mesh8):
+    pristine = jax.device_get(_mlp_state())
+    spec, state = _kit(mesh8, pristine)
+    with Checkpointer(tmp_path / "ck") as ck:
+        ck.save(1, state, wait=True, manifest=False)
+        assert ck.read_manifest(1) is None
+        restored, step, info = restore_resharded(
+            ck, place_state(pristine, mesh8, spec), mesh=mesh8,
+            state_spec=spec)
+    assert step == 1 and info["mode"] == "legacy"
+    assert _params_close(restored, state, exact=True)
+
+
+def test_corrupt_latest_falls_back_across_topologies(tmp_path, mesh8):
+    """Integrity chain survives the mesh change: truncated latest is
+    quarantined, restore reshards the previous verified-good step."""
+    mesh4 = _mesh({"data": 4})
+    pristine = jax.device_get(_mlp_state())
+    spec8, state8 = _kit(mesh8, pristine)
+    spec4, _ = _kit(mesh4, pristine)
+    with Checkpointer(tmp_path / "ck") as ck:
+        ck.save(1, state8, wait=True)
+        ck.save(2, state8, wait=True)
+        ChaosPlan.truncate_checkpoint(str(tmp_path / "ck"), 2)
+        restored, step, info = restore_resharded(
+            ck, place_state(pristine, mesh4, spec4), mesh=mesh4,
+            state_spec=spec4)
+        assert step == 1 and restored is not None
+        assert ck.latest_step() == 1
+    q = tmp_path / "ck" / "quarantine"
+    assert any(n.startswith("2") for n in os.listdir(q))
+
+
+def test_wrong_model_raises_geometry_error(tmp_path, mesh8):
+    pristine = jax.device_get(_mlp_state(hidden=48))
+    spec, state = _kit(mesh8, pristine)
+    other = jax.device_get(_mlp_state(hidden=32))
+    ospec, otarget = _kit(mesh8, other)
+    with Checkpointer(tmp_path / "ck") as ck:
+        ck.save(1, state, wait=True)
+        with pytest.raises(ReshardGeometryError, match="geometry differs"):
+            restore_resharded(ck, place_state(other, mesh8, ospec),
+                              mesh=mesh8, state_spec=ospec)
+    # the mismatch must NOT have quarantined the (healthy) checkpoint
+    assert not os.path.isdir(tmp_path / "ck" / "quarantine")
+
+
+def test_empty_dir_returns_none(tmp_path, mesh8):
+    pristine = jax.device_get(_mlp_state())
+    spec, _ = _kit(mesh8, pristine)
+    with Checkpointer(tmp_path / "ck") as ck:
+        state, step, info = restore_resharded(
+            ck, place_state(pristine, mesh8, spec), mesh=mesh8,
+            state_spec=spec)
+    assert state is None and step is None and info["mode"] is None
+    assert latest_topology(str(tmp_path / "ck")) == (None, None)
+
+
+# --- re-planning -------------------------------------------------------------
+
+_PINNED = {"dtypes": ("float32",), "grad_accum_options": (1,),
+           "attention_options": ("auto",), "zero_options": ("1",),
+           "compress_options": ("none",)}
+
+
+def test_choose_plan_uses_all_survivors_when_batch_divides():
+    plan = choose_plan(6, 96, space_options=_PINNED)
+    assert plan.n_devices == 6
+    assert plan.mesh_dict().get("data") == 6
+
+
+def test_choose_plan_steps_down_when_batch_does_not_divide():
+    plan = choose_plan(6, 64, space_options=_PINNED)
+    assert plan.n_devices == 4  # 64 % 6 != 0: largest legal subset
+
+
+def test_choose_plan_exhausted_raises():
+    with pytest.raises(ValueError, match="no legal plan"):
+        choose_plan(2, 7, allow_fewer=False, space_options=_PINNED)
+
+
+# --- chaos injector ----------------------------------------------------------
+
+def test_shrink_topology_seeded_and_validated():
+    devices = list(range(8))
+    a_surv, a_dead = ChaosPlan.shrink_topology(devices, kill=2, seed=5)
+    b_surv, b_dead = ChaosPlan.shrink_topology(devices, kill=2, seed=5)
+    assert (a_surv, a_dead) == (b_surv, b_dead)  # bit-identical replay
+    assert len(a_surv) == 6 and len(a_dead) == 2
+    assert sorted(a_surv + [devices[i] for i in a_dead]) == devices
+    c_surv, _ = ChaosPlan.shrink_topology(devices, kill=2, seed=6)
+    assert c_surv != a_surv or True  # different seed MAY differ; no crash
+    with pytest.raises(ValueError, match="kill"):
+        ChaosPlan.shrink_topology(devices, kill=0)
+    with pytest.raises(ValueError, match="kill"):
+        ChaosPlan.shrink_topology(devices, kill=8)
+
+
+# --- CLI wiring --------------------------------------------------------------
+
+def test_reshard_cli_flags(tmp_path):
+    from distributed_deep_learning_tpu.utils.config import parse_args
+
+    d = str(tmp_path / "ck")
+    cfg = parse_args(["--reshard", "--resume", "--checkpoint-dir", d],
+                     workload="mlp")
+    assert cfg.reshard and cfg.target_mesh is None
+    cfg = parse_args(["--reshard", "--elastic", "--checkpoint-dir", d,
+                      "--target-mesh", "data=2,fsdp=2"], workload="mlp")
+    assert cfg.target_mesh == {"data": 2, "fsdp": 2}
+    with pytest.raises(SystemExit, match="resume or --elastic"):
+        parse_args(["--reshard", "--checkpoint-dir", d], workload="mlp")
+    with pytest.raises(SystemExit, match="checkpoint-dir"):
+        parse_args(["--reshard", "--resume"], workload="mlp")
+    with pytest.raises(SystemExit, match="target-mesh requires"):
+        parse_args(["--target-mesh", "data=4"], workload="mlp")
+    with pytest.raises(SystemExit, match="known axes"):
+        parse_args(["--reshard", "--resume", "--checkpoint-dir", d,
+                    "--target-mesh", "bogus=4"], workload="mlp")
+
+
+# --- the full drill (slow) ---------------------------------------------------
+
+@pytest.mark.slow
+def test_full_shrink_drill():
+    from distributed_deep_learning_tpu.reshard.drill import run_shrink_drill
+
+    rec = run_shrink_drill(seed=0)
+    assert rec["drill_passed"], rec
+    assert rec["survivors"] == 6 and rec["non_power_of_two"]
+    assert rec["params_allclose"] and rec["opt_state_allclose"]
+    assert rec["loss_allclose"]
+    assert rec["restore_mode"] in ("chunked", "gather")
+
+
+@pytest.mark.slow
+def test_chaos_drill_script_shrink_smoke():
+    import subprocess
+    import sys
+
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "chaos_drill.py")
+    proc = subprocess.run(
+        [sys.executable, script, "--scenario", "shrink", "--seed", "0"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["drill_passed"] and line["metric"] == "shrink drill"
