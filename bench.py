@@ -20,23 +20,11 @@ import os
 import sys
 import time
 
-# Chip peak dense-bf16 FLOP/s by device_kind substring (ordered: first match
-# wins; "lite" variants checked before their full-size siblings).
-PEAK_BF16_FLOPS = (
-    ("v6 lite", 918e12), ("v6e", 918e12),
-    ("v5 lite", 197e12), ("v5e", 197e12),
-    ("v5p", 459e12), ("v5", 459e12),
-    ("v4 lite", 138e12), ("v4i", 138e12), ("v4", 275e12),
-    ("v3", 123e12), ("v2", 45e12),
-)
-
-
-def chip_peak_flops(device_kind: str) -> float | None:
-    kind = device_kind.lower()
-    for sub, peak in PEAK_BF16_FLOPS:
-        if sub in kind:
-            return peak
-    return None
+# Chip peak table + lookup now live with the MFU accounting in obs/mfu.py
+# (ISSUE 7); re-exported here so existing `from bench import ...` users keep
+# working.  The import is cheap — obs.mfu touches neither jax nor devices.
+from distributed_deep_learning_tpu.obs.mfu import (  # noqa: E402,F401
+    PEAK_BF16_FLOPS, chip_peak_flops)
 
 
 def _devices_or_cpu_fallback():
@@ -544,6 +532,21 @@ def _reshard() -> dict | None:
     return out
 
 
+def _observability() -> dict | None:
+    """Telemetry overhead A/B (ISSUE 7): steps/sec with RunTelemetry
+    attached vs the bare train loop, on the real ``_run_phase`` over a
+    ~1 ms jitted step — the worst case for per-step instrumentation
+    cost.  CPU-measurable (the hot path is host-side ``perf_counter``
+    reads + dict adds either way).  The acceptance bar is overhead
+    < 2%; the measured fraction is tracked under
+    ``{platform}:obs_overhead_fraction_v1``."""
+    from distributed_deep_learning_tpu.obs.bench import overhead_bench
+
+    return overhead_bench(
+        steps=int(os.environ.get("BENCH_OBS_STEPS", 48)),
+        repeats=int(os.environ.get("BENCH_OBS_REPEATS", 5)))
+
+
 def _attention_speedup(steps: int = 20) -> float | None:
     """Fused (Pallas flash) vs dense attention fwd+bwd at a long-context
     shape; returns flash/dense step-time ratio > 1 = flash faster.  TPU
@@ -889,6 +892,27 @@ def main() -> None:
             print(f"bench: reshard section failed "
                   f"({type(exc).__name__}: {exc})", file=sys.stderr)
 
+    # --- observability: telemetry overhead on the train loop ---------------
+    observability = None
+    t_obs = 60 if on_tpu else 45
+    if os.environ.get("BENCH_OBS", "1") != "0" and _time_left() < t_obs:
+        print(f"bench: shedding observability section ({_time_left():.0f}s "
+              "left)", file=sys.stderr)
+    elif os.environ.get("BENCH_OBS", "1") != "0":
+        try:
+            with _section_timer("observability"):
+                observability = _observability()
+            # lower is better, but _vs_baseline just ratios against the
+            # first recorded value — drift either way shows up
+            ovs = _vs_baseline(baselines,
+                               f"{platform}:obs_overhead_fraction_v1",
+                               observability["obs_overhead_fraction"],
+                               base_path)
+            observability["vs_baseline"] = round(ovs, 4)
+        except Exception as exc:
+            print(f"bench: observability section failed "
+                  f"({type(exc).__name__}: {exc})", file=sys.stderr)
+
     attn_speedup = None
     if on_tpu and os.environ.get("BENCH_ATTENTION", "1") != "0":
         if _time_left() < 90:
@@ -921,6 +945,7 @@ def main() -> None:
         "resilience": resilience,
         "autotune": autotune,
         "reshard": reshard,
+        "observability": observability,
         "flash_attention_speedup":
             round(attn_speedup, 3) if attn_speedup else None,
         "section_secs": section_secs,
@@ -1029,7 +1054,8 @@ def orchestrate() -> int:
     # set can never fit, but headline-only with a warm compile cache can).
     shed = {"BENCH_SECONDARY": "0", "BENCH_LM": "0", "BENCH_INPUT": "0",
             "BENCH_ATTENTION": "0", "BENCH_SERVE": "0",
-            "BENCH_RESILIENCE": "0", "BENCH_RESHARD": "0"}
+            "BENCH_RESILIENCE": "0", "BENCH_RESHARD": "0",
+            "BENCH_OBS": "0"}
     plan: list[dict] = [{}] if pinned else [
         {"BENCH_BATCH_PER_CHIP": "256"},
         {"BENCH_BATCH_PER_CHIP": "128", **shed},

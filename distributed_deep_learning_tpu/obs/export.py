@@ -1,0 +1,156 @@
+"""Telemetry export: JSONL event stream + Prometheus text exposition.
+
+The JSONL stream extends ``PhaseLogger``'s sidecar grammar — every line
+is ``{"event": <name>, "t": <monotonic seconds>, **fields}`` — so a
+run's obs stream and its phase log speak the same dialect and a single
+reader (:func:`read_events`) serves both.  Obs-specific events:
+
+* ``obs_goodput``  — a goodput breakdown (``scope``: phase label or
+  ``"run"``), fields from ``Timeline.goodput()``.
+* ``obs_mfu``      — an ``mfu.mfu_record`` dict.
+* ``obs_snapshot`` — a full ``MetricsRegistry.snapshot()``.
+* ``obs_serve``    — serve engine stats (latency percentiles included).
+
+:func:`prometheus_text` renders a registry snapshot in the Prometheus
+text exposition format (cumulative ``le`` buckets, ``_sum``/``_count``)
+so a scrape endpoint or a file-based textfile collector can serve it
+without any new dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+from typing import Any, Iterator
+
+
+class EventWriter:
+    """Line-buffered JSONL appender in the PhaseLogger sidecar grammar.
+
+    Safe to construct with ``path=None`` (all writes become no-ops), so
+    call sites never need their own ``if telemetry`` guards.
+    """
+
+    def __init__(self, path: str | None,
+                 clock=time.perf_counter) -> None:
+        self.path = path
+        self.clock = clock
+        self._fh = open(path, "a", buffering=1) if path else None
+
+    def emit(self, event: str, **fields: Any) -> None:
+        if self._fh is None:
+            return
+        rec = {"event": event, "t": self.clock(), **fields}
+        # allow_nan=False because json would otherwise emit the literal
+        # ``NaN`` — valid to json.loads but poison to strict readers
+        # (jq, browsers); _json_default cannot intercept floats (they
+        # are natively serializable), so non-finite floats route through
+        # the ValueError path and get scrubbed to None.
+        try:
+            line = json.dumps(rec, default=_json_default, allow_nan=False)
+        except ValueError:
+            line = json.dumps(_scrub(rec), default=_json_default,
+                              allow_nan=False)
+        self._fh.write(line + "\n")
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+def _scrub(o: Any):
+    """Recursively replace non-finite floats with None (cold path: only
+    runs when a record actually contains one)."""
+    if isinstance(o, float):
+        return o if math.isfinite(o) else None
+    if isinstance(o, dict):
+        return {k: _scrub(v) for k, v in o.items()}
+    if isinstance(o, (list, tuple)):
+        return [_scrub(v) for v in o]
+    return o
+
+
+def _json_default(o: Any):
+    """Last-resort encoder: inf/nan → None (JSON has no inf), arrays and
+    numpy scalars → python."""
+    if isinstance(o, float):
+        return None if not math.isfinite(o) else o
+    tolist = getattr(o, "tolist", None)
+    if tolist is not None:
+        return tolist()
+    item = getattr(o, "item", None)
+    if item is not None:
+        return item()
+    return str(o)
+
+
+def read_events(path: str, event: str | None = None) -> Iterator[dict]:
+    """Yield event dicts from a JSONL sidecar (PhaseLogger or obs),
+    optionally filtered by event name.  Tolerates a torn final line
+    (a killed run mid-write) by skipping undecodable lines."""
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if event is None or rec.get("event") == event:
+                yield rec
+
+
+def _prom_name(key: str) -> tuple[str, str]:
+    """Split a registry key ``name{a=b}`` into (metric name, label part
+    incl. braces or empty), quoting label values per the exposition
+    format."""
+    if "{" not in key:
+        return key, ""
+    name, _, rest = key.partition("{")
+    inner = rest.rstrip("}")
+    quoted = ",".join(
+        f'{k}="{v}"' for k, _, v in
+        (pair.partition("=") for pair in inner.split(","))
+    )
+    return name, "{" + quoted + "}"
+
+
+def _fmt(v: float) -> str:
+    if v != v:  # NaN
+        return "NaN"
+    if v in (math.inf, -math.inf):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def prometheus_text(snapshot: dict) -> str:
+    """Render a ``MetricsRegistry.snapshot()`` in Prometheus text
+    format.  Histogram buckets are emitted cumulatively with ``le``
+    upper bounds plus the ``+Inf`` bucket, ``_sum`` and ``_count``."""
+    lines: list[str] = []
+    for key, v in sorted(snapshot.get("counters", {}).items()):
+        name, labels = _prom_name(key)
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name}_total{labels} {_fmt(v)}")
+    for key, v in sorted(snapshot.get("gauges", {}).items()):
+        name, labels = _prom_name(key)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{labels} {_fmt(v)}")
+    for key, h in sorted(snapshot.get("histograms", {}).items()):
+        name, labels = _prom_name(key)
+        base = labels[1:-1] if labels else ""
+        lines.append(f"# TYPE {name} histogram")
+        cum = 0
+        for bound, c in zip(h["bounds"], h["counts"]):
+            cum += c
+            lab = f'{base},le="{_fmt(float(bound))}"' if base \
+                else f'le="{_fmt(float(bound))}"'
+            lines.append(f"{name}_bucket{{{lab}}} {cum}")
+        lab = f'{base},le="+Inf"' if base else 'le="+Inf"'
+        lines.append(f"{name}_bucket{{{lab}}} {h['count']}")
+        lines.append(f"{name}_sum{labels} {_fmt(h['sum'])}")
+        lines.append(f"{name}_count{labels} {h['count']}")
+    return "\n".join(lines) + ("\n" if lines else "")

@@ -77,10 +77,13 @@ def make_trace(n_requests: int, *, vocab_size: int, seed: int = 0,
     return reqs
 
 
-def run_engine(model, params, requests: Sequence[Request], **engine_kw):
-    """One engine lifetime over the trace; returns the engine's record."""
+def run_engine(model, params, requests: Sequence[Request], telemetry=None,
+               **engine_kw):
+    """One engine lifetime over the trace; returns the engine's record.
+    ``telemetry`` (a :class:`..obs.RunTelemetry`) routes the engine's
+    latency histograms into the run's shared registry + event stream."""
     eng = ServeEngine(model, params, **engine_kw)
-    return eng.run(requests)
+    return eng.run(requests, telemetry=telemetry)
 
 
 def run_naive(model, params, requests: Sequence[Request],
@@ -168,6 +171,12 @@ def serving_bench(*, seed: int = 0, n_requests: int = 32,
             "prefill_compiles": es["prefill_compiles"],
             "decode_compiles": es["decode_compiles"],
             "buckets": es["buckets"],
+            # per-request latency percentiles from the engine's
+            # log-bucketed histograms (obs/metrics.py) — TTFT anchors at
+            # the wall time the arrival tick was reached, so queue wait
+            # under load is counted
+            "latency": {k: (round(v, 5) if isinstance(v, float) else v)
+                        for k, v in es["latency"].items()},
         },
     }
     if not skip_naive:

@@ -38,6 +38,7 @@ import numpy as np
 from distributed_deep_learning_tpu.models.transformer import (
     CausalLM, cached_apply, make_decode_model, sample_tokens,
     validate_sampling)
+from distributed_deep_learning_tpu.obs.metrics import MetricsRegistry
 from distributed_deep_learning_tpu.serve import cache as slot_cache
 from distributed_deep_learning_tpu.serve.scheduler import (Request,
                                                            SlotScheduler)
@@ -179,12 +180,21 @@ class ServeEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
-    def run(self, requests: Iterable[Request]) -> dict:
+    def run(self, requests: Iterable[Request],
+            telemetry=None) -> dict:
         """Serve a whole trace; returns ``{"results", "errors", "stats"}``.
 
         ``results`` maps uid -> generated token array; ``stats`` carries
         the throughput/occupancy/compile accounting the serving bench
-        reports.
+        reports, plus a ``latency`` sub-dict (p50/p99 TTFT, inter-token,
+        end-to-end seconds) from per-request histograms.  Latency anchors
+        at the wall time a request's arrival tick is first REACHED — so
+        TTFT includes queue wait under load, the user-visible number.
+
+        ``telemetry`` (:class:`..obs.RunTelemetry`) routes the latency/
+        queue instruments into the run-level registry and emits an
+        ``obs_serve`` event; without it the engine keeps a private
+        per-run registry (percentiles are reported either way).
 
         Validation is PER REQUEST at submit: an invalid request (oversize
         prompt, prompt + ``max_new_tokens`` beyond the slot capacity) is
@@ -206,10 +216,31 @@ class ServeEngine:
             sched.submit(req)
             n_req += 1
 
+        reg = telemetry.registry if telemetry is not None \
+            else MetricsRegistry()
+        h_ttft = reg.histogram("serve_ttft_seconds")
+        h_itl = reg.histogram("serve_intertoken_seconds")
+        h_e2e = reg.histogram("serve_e2e_seconds")
+        h_tick = reg.histogram("serve_decode_tick_seconds")
+        g_queue = reg.gauge("serve_queue_depth")
+        g_occ = reg.gauge("serve_slot_occupancy")
+        first_wall: dict[int, float] = {}  # uid -> first-token wall time
+
+        def retire(req, now):
+            """Observe a retired request's TTFT-anchored latencies."""
+            arr = sched.arrival_wall.get(req.uid, now)
+            h_e2e.observe(now - arr)
+            n_tok = len(sched.finished[req.uid])
+            fw = first_wall.pop(req.uid, None)
+            if fw is not None and n_tok > 1:
+                h_itl.observe((now - fw) / (n_tok - 1))
+
         t_start = time.perf_counter()
         t_prefill = t_decode = 0.0
         tick = prefill_calls = decode_ticks = occupancy_sum = 0
         while sched.pending or sched.occupancy:
+            sched.mark_arrivals(tick, time.perf_counter())
+            g_queue.set(sched.queue_depth(tick))
             # admit every arrived request a free slot can take; a row
             # retired below frees its slot for the very next tick's admit
             while True:
@@ -226,9 +257,14 @@ class ServeEngine:
                     np.int32(idx), np.int32(len(req.prompt)),
                     self._next_key())
                 first = int(tok)          # host fetch = device barrier
-                t_prefill += time.perf_counter() - t0
+                now = time.perf_counter()
+                t_prefill += now - t0
                 prefill_calls += 1
-                sched.record(idx, first, self.eos_id)
+                first_wall[req.uid] = now
+                h_ttft.observe(now - sched.arrival_wall.get(req.uid, t0))
+                done = sched.record(idx, first, self.eos_id)
+                if done is not None:
+                    retire(done, now)
 
             if not sched.occupancy:
                 nxt = sched.next_arrival()
@@ -238,19 +274,35 @@ class ServeEngine:
                 continue
 
             occupancy_sum += sched.occupancy
+            g_occ.set(sched.occupancy)
             t0 = time.perf_counter()
             self.slots, out = self._decode(self.params, self.slots,
                                            jnp.asarray(sched.last_tokens()),
                                            self._next_key())
             out = np.asarray(out)         # host fetch = device barrier
-            t_decode += time.perf_counter() - t0
+            now = time.perf_counter()
+            t_decode += now - t0
+            h_tick.observe(now - t0)
             decode_ticks += 1
             for idx in sched.active_slots:
-                sched.record(idx, int(out[idx]), self.eos_id)
+                done = sched.record(idx, int(out[idx]), self.eos_id)
+                if done is not None:
+                    retire(done, now)
             tick += 1
 
         total = time.perf_counter() - t_start
         tokens = int(sum(len(v) for v in sched.finished.values()))
+        latency = {
+            "ttft_p50_s": h_ttft.percentile(50),
+            "ttft_p99_s": h_ttft.percentile(99),
+            "ttft_mean_s": h_ttft.mean,
+            "itl_p50_s": h_itl.percentile(50),
+            "itl_p99_s": h_itl.percentile(99),
+            "e2e_p50_s": h_e2e.percentile(50),
+            "e2e_p99_s": h_e2e.percentile(99),
+            "e2e_max_s": h_e2e.max if h_e2e.count else None,
+            "measured_requests": h_e2e.count,
+        }
         stats = {
             "requests": n_req,
             "rejected": len(errors),
@@ -267,5 +319,8 @@ class ServeEngine:
             "prefill_compiles": self._prefill.traces,
             "decode_compiles": self._decode.traces,
             "buckets": list(self.buckets),
+            "latency": latency,
         }
+        if telemetry is not None:
+            telemetry.writer.emit("obs_serve", stats=stats)
         return {"results": sched.finished, "errors": errors, "stats": stats}

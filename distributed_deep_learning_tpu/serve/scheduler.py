@@ -65,6 +65,10 @@ class SlotScheduler:
         self.slots = [_Slot() for _ in range(max_slots)]
         self._queue: list[Request] = []   # arrival-tick then submit order
         self.finished: dict[int, np.ndarray] = {}
+        # uid -> wall time its arrival tick was first reached (stamped by
+        # mark_arrivals; latency measurements anchor here so TTFT includes
+        # queue wait, not just prefill)
+        self.arrival_wall: dict[int, float] = {}
 
     # --- queue -----------------------------------------------------------
     def submit(self, request: Request) -> None:
@@ -86,6 +90,27 @@ class SlotScheduler:
 
     def next_arrival(self) -> Optional[int]:
         return self._queue[0].arrival_tick if self._queue else None
+
+    def mark_arrivals(self, tick: int, now: float) -> None:
+        """Stamp the wall time every newly-arrived request became
+        visible (``arrival_tick <= tick``).  The queue is sorted by
+        arrival tick, so this walks only the arrived prefix; re-marking
+        is a no-op (the FIRST sighting is the arrival)."""
+        for req in self._queue:
+            if req.arrival_tick > tick:
+                break
+            self.arrival_wall.setdefault(req.uid, now)
+
+    def queue_depth(self, tick: int) -> int:
+        """Requests that have ARRIVED but hold no slot yet — the depth a
+        user-facing queue gauge should report (future-tick arrivals are
+        not waiting on anyone)."""
+        depth = 0
+        for req in self._queue:
+            if req.arrival_tick > tick:
+                break
+            depth += 1
+        return depth
 
     # --- placement / retirement ------------------------------------------
     def place(self, tick: int) -> Optional[tuple[int, Request]]:

@@ -81,8 +81,8 @@ def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
                       monitor: FailureMonitor | None = None,
                       max_restarts: int = 2,
                       checkpoint_every: int | None = None,
-                      sentinel=None, chaos=None, restore_fn=None
-                      ) -> tuple[Any, list[EpochResult]]:
+                      sentinel=None, chaos=None, restore_fn=None,
+                      telemetry=None) -> tuple[Any, list[EpochResult]]:
     """Run :func:`..loop.fit` with checkpointed restart on failure.
 
     ``make_state`` builds a FRESH initial state (used as the restore
@@ -116,6 +116,11 @@ def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
       :func:`..reshard.restore.make_restore_fn` here so a restart on a
       different surviving mesh reshards the checkpoint transparently;
       every quarantine/fallback guarantee above still holds.
+
+    ``telemetry`` (:class:`..obs.RunTelemetry`) attributes every restore
+    to the ``recovery`` span (reshard restores separately record their
+    redistribution under ``reshard``), counts restarts, and rides into
+    :func:`..loop.fit` for step-span recording.
     """
     logger = logger or PhaseLogger(verbose=False)
     train_loader, val_loader, test_loader = loaders
@@ -130,8 +135,13 @@ def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
         # resume point: a step save scheduled just before the failure must
         # be visible to this retry, or it would resume from an older
         # boundary and try to re-save an id that then finalises under it
-        restored, ckpt_step = (restore_fn or
-                               checkpointer.restore_verified)(state)
+        if telemetry is None:
+            restored, ckpt_step = (restore_fn or
+                                   checkpointer.restore_verified)(state)
+        else:
+            with telemetry.timeline.span("recovery"):
+                restored, ckpt_step = (restore_fn or
+                                       checkpointer.restore_verified)(state)
         if ckpt_step is not None:
             state = restored
             _, start_epoch, resume_batch, resume_totals = \
@@ -160,7 +170,8 @@ def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
                            resume_batch=resume_batch,
                            resume_totals=resume_totals, history_sink=sink,
                            sentinel=sentinel, chaos=chaos,
-                           skip_steps=skip_steps or None)
+                           skip_steps=skip_steps or None,
+                           telemetry=telemetry)
             return state, _merge_history(sink)
         except AnomalyError as e:
             if e.policy != "rollback":
@@ -168,6 +179,9 @@ def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
             restarts += 1
             if restarts > max_restarts:
                 raise
+            if telemetry is not None:
+                telemetry.registry.counter(
+                    "elastic_restarts", cause="sentinel_rollback").inc()
             skip_steps.add(e.global_step)
             checkpointer.wait_until_finished()
             logger.info(f"sentinel rollback ({e}); restart "
@@ -191,6 +205,9 @@ def fit_with_recovery(make_state: Callable[[], Any], train_step, eval_step,
             restarts += 1
             if restarts > max_restarts:
                 raise
+            if telemetry is not None:
+                telemetry.registry.counter(
+                    "elastic_restarts", cause=type(e).__name__).inc()
             # flush BEFORE reading the point for the log too, or a save
             # still in flight makes the message claim an older boundary
             # than the retry will actually use (review finding)

@@ -54,7 +54,8 @@ def _sum_totals(device_metrics, init_totals=None):
 
 def _run_phase(step_fn, state, loader, *, train: bool, monitor=None,
                skip: int = 0, init_totals=None, on_step=None,
-               batch_hook=None, skip_pred=None, check_anomaly=None):
+               batch_hook=None, skip_pred=None, check_anomaly=None,
+               telemetry=None):
     """Drive one phase; returns (state, totals) with one host sync at end.
 
     ``skip`` batches are consumed-but-not-trained (mid-epoch resume: the
@@ -74,7 +75,13 @@ def _run_phase(step_fn, state, loader, *, train: bool, monitor=None,
     dispatched, so the device pipeline stays busy and detection still
     lands within one step.  Anomalous steps were already contained on
     device, so even the saves ``on_step`` makes in that lag window hold
-    clean state."""
+    clean state.
+
+    ``telemetry`` (:class:`..obs.RunTelemetry`) records per-step spans:
+    ``data_wait`` around ``next(loader)``, ``dispatch`` around the step
+    call (the FIRST dispatch of a given step fn attributed to
+    ``compile``), ``device_sync`` around the end-of-phase host fetch.
+    The None path is the exact pre-telemetry loop — zero added work."""
     device_metrics = []
     pending = None  # (batch_idx, metrics) awaiting the lag-1 anomaly check
     if skip and hasattr(loader, "iter_batches"):
@@ -83,7 +90,21 @@ def _run_phase(step_fn, state, loader, *, train: bool, monitor=None,
         import itertools
 
         batches = itertools.islice(iter(loader), skip, None)
-    for i, (x, y) in enumerate(batches, start=skip):
+    tl = telemetry.timeline if telemetry is not None else None
+    it = enumerate(batches, start=skip)
+    while True:
+        if tl is None:
+            try:
+                i, (x, y) = next(it)
+            except StopIteration:
+                break
+        else:
+            t = tl.clock()
+            try:
+                i, (x, y) = next(it)
+            except StopIteration:
+                break
+            tl.add("data_wait", tl.clock() - t)
         if monitor is not None:
             # cheap per-step liveness poll (an attribute read): a peer dying
             # mid-epoch surfaces HERE instead of hanging the next collective
@@ -93,9 +114,21 @@ def _run_phase(step_fn, state, loader, *, train: bool, monitor=None,
                 continue  # poisoned data window: consumed, never trained
             if batch_hook is not None:
                 x, y = batch_hook(i + 1, x, y)
-            state, m = step_fn(state, x, y)
-        else:
+            if tl is None:
+                state, m = step_fn(state, x, y)
+            else:
+                kind = telemetry.dispatch_kind(step_fn)
+                t = tl.clock()
+                state, m = step_fn(state, x, y)
+                tl.add(kind, tl.clock() - t)
+                tl.step()
+        elif tl is None:
             m = step_fn(state, x, y)
+        else:
+            kind = telemetry.dispatch_kind(step_fn)
+            t = tl.clock()
+            m = step_fn(state, x, y)
+            tl.add(kind, tl.clock() - t)
         device_metrics.append(m)
         if check_anomaly is not None:
             if pending is not None:
@@ -106,7 +139,10 @@ def _run_phase(step_fn, state, loader, *, train: bool, monitor=None,
                     lambda: _sum_totals(device_metrics, init_totals))
     if pending is not None:
         check_anomaly(*pending)
-    return state, _sum_totals(device_metrics, init_totals)
+    if tl is None:
+        return state, _sum_totals(device_metrics, init_totals)
+    with tl.span("device_sync"):
+        return state, _sum_totals(device_metrics, init_totals)
 
 
 def _result(phase: str, epoch: int | None, totals, t0: float, t1: float) -> EpochResult:
@@ -127,8 +163,8 @@ def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
         checkpoint_every: int | None = None, resume_batch: int = 0,
         resume_totals: dict | None = None,
         history_sink: list | None = None,
-        sentinel=None, chaos=None, skip_steps=None
-        ) -> tuple[TrainState, list[EpochResult]]:
+        sentinel=None, chaos=None, skip_steps=None, *,
+        telemetry=None) -> tuple[TrainState, list[EpochResult]]:
     """Drive the epoch loop.  With a ``checkpointer``
     (:class:`..utils.checkpoint.Checkpointer`) the state is saved after
     every epoch (async) — pass ``start_epoch`` = last saved epoch + 1 to
@@ -161,7 +197,12 @@ def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
     ``chaos`` (:class:`..utils.chaos.ChaosPlan`) injects planned faults
     into train batches; ``skip_steps`` (a set of GLOBAL train-step ids) is
     the rollback replay's poisoned window — those batches are consumed but
-    never trained."""
+    never trained.
+
+    ``telemetry`` (:class:`..obs.RunTelemetry`, keyword-only) turns on
+    span recording: per-step data-wait/dispatch/sync spans in
+    ``_run_phase``, checkpoint spans around every save, a per-train-phase
+    goodput rollup event, and sentinel containment counters."""
     logger = logger or PhaseLogger(verbose=False)
     history: list[EpochResult] = \
         [] if history_sink is None else history_sink
@@ -207,12 +248,16 @@ def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
             maybe_inject_step_failure(gstep)  # DDL_INJECT_STEP_FAILURE
             if checkpointer is not None and checkpoint_every \
                     and b % checkpoint_every == 0 and b < spe:
+                ck0 = telemetry.timeline.clock() if telemetry else None
                 t = totals_fn()
                 checkpointer.save(
                     gstep, st,
                     extra={"epoch": _epoch, "batch": b,
                            "epoch_complete": False,
                            "totals": {k: float(v) for k, v in t.items()}})
+                if telemetry is not None:
+                    telemetry.timeline.add(
+                        "checkpoint", telemetry.timeline.clock() - ck0)
 
         batch_hook = skip_pred = check_anomaly = None
         if chaos is not None:
@@ -232,12 +277,14 @@ def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
                                        int(float(m["anomaly_code"])))
 
         t0 = logger.phase_begin("train", epoch)
+        phase_mark = telemetry.timeline.snapshot() if telemetry else None
         state, totals = _run_phase(train_step, state, train_loader,
                                    train=True, monitor=monitor, skip=skip,
                                    init_totals=init_totals, on_step=on_step,
                                    batch_hook=batch_hook,
                                    skip_pred=skip_pred,
-                                   check_anomaly=check_anomaly)
+                                   check_anomaly=check_anomaly,
+                                   telemetry=telemetry)
         t1 = logger.clock()
         if sentinel is not None and totals.get("anomaly"):
             # contained on device — say so (the run's health story must be
@@ -246,6 +293,14 @@ def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
                         f"anomalous step(s) in epoch {epoch} "
                         f"(policy={sentinel.policy})")
         res = _result("train", epoch, totals, t0, t1)
+        if telemetry is not None:
+            if totals.get("anomaly"):
+                telemetry.registry.counter("sentinel_anomalies").inc(
+                    float(totals["anomaly"]))
+            gp = telemetry.phase_rollup(f"train_epoch_{epoch}",
+                                        since=phase_mark)
+            telemetry.note_train(gp["steps"], gp["wall_seconds"],
+                                 res.examples)
         logger.phase_end("train", epoch, accuracy=res.accuracy, loss=res.loss)
         # beyond-reference observability: throughput counters per phase
         logger.metrics(phase="train", epoch=epoch,
@@ -255,7 +310,7 @@ def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
 
         t0 = logger.clock()
         _, totals = _run_phase(eval_step, state, val_loader, train=False,
-                               monitor=monitor)
+                               monitor=monitor, telemetry=telemetry)
         t1 = logger.clock()
         res = _result("validation", epoch, totals, t0, t1)
         # reference prints only the validation end line (CNN/main.py:111)
@@ -266,15 +321,24 @@ def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
             # uniform global-step ids under step cadence; legacy epoch ids
             # without (keeps old run dirs resumable)
             step_id = epoch * spe if checkpoint_every else epoch
+            ck0 = telemetry.timeline.clock() if telemetry else None
             checkpointer.save(step_id, state,
                               extra={"epoch": epoch, "batch": spe,
                                      "epoch_complete": True})
+            if telemetry is not None:
+                telemetry.timeline.add(
+                    "checkpoint", telemetry.timeline.clock() - ck0)
 
     if checkpointer is not None:
-        checkpointer.wait_until_finished()
+        if telemetry is None:
+            checkpointer.wait_until_finished()
+        else:
+            with telemetry.timeline.span("checkpoint"):
+                checkpointer.wait_until_finished()
 
     t0 = logger.clock()
-    _, totals = _run_phase(eval_step, state, test_loader, train=False)
+    _, totals = _run_phase(eval_step, state, test_loader, train=False,
+                           telemetry=telemetry)
     t1 = logger.clock()
     res = _result("test", None, totals, t0, t1)
     logger.phase_end("test", accuracy=res.accuracy, loss=res.loss)

@@ -184,6 +184,12 @@ class Config:
     warmup_steps: int | None = None     # cosine/rsqrt warmup; None = 5% auto
     clip_norm: float | None = None      # global-norm gradient clipping
     metrics_file: str | None = None     # JSONL event sink (rank 0)
+    obs: bool = False                   # unified run telemetry (obs/):
+                                        #   goodput/MFU accounting + JSONL
+                                        #   event stream
+    obs_file: str | None = None         # telemetry sidecar path (default
+                                        #   obs_events.jsonl; non-rank-0
+                                        #   processes get .rankN suffix)
     sentinel: str = "off"               # anomaly sentinel policy:
                                         #   off|skip|rollback|halt
                                         #   (train/sentinel.py)
@@ -397,6 +403,16 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
     p.add_argument("--metrics-file", type=str, default=None,
                    help="append one JSON object per phase/metric event "
                         "(structured sibling of the reference log stream)")
+    p.add_argument("--obs", action="store_true",
+                   help="unified run telemetry (obs/): per-step span "
+                        "recording rolled up into a goodput breakdown "
+                        "(productive/input-stall/checkpoint/recovery/"
+                        "compile), MFU from the compiled step's cost "
+                        "model, and a JSONL event stream readable by "
+                        "scripts/obs_report.py")
+    p.add_argument("--obs-file", type=str, default=None, metavar="PATH",
+                   help="telemetry event-stream path (default "
+                        "obs_events.jsonl; requires --obs)")
     p.add_argument("--pipeline-schedule",
                    choices=["gpipe", "1f1b", "interleaved"],
                    default="gpipe",
@@ -556,6 +572,9 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
     if args.plan_file and not args.autotune and not os.path.exists(args.plan_file):
         raise SystemExit(f"--plan {args.plan_file}: no such file (run "
                          "--autotune to produce one)")
+    if args.obs_file and not args.obs:
+        raise SystemExit("--obs-file requires --obs (the path names the "
+                         "telemetry stream --obs records)")
     return Config(
         num_layers=args.nlayers,
         size=args.size,
@@ -603,6 +622,8 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         warmup_steps=args.warmup_steps,
         clip_norm=args.clip_norm,
         metrics_file=args.metrics_file,
+        obs=args.obs,
+        obs_file=args.obs_file,
         sentinel=args.sentinel,
         sentinel_window=args.sentinel_window,
         sentinel_factor=args.sentinel_factor,

@@ -25,7 +25,9 @@ class PhaseLogger:
     ``jsonl_path`` additionally appends one machine-readable JSON object
     per event (``{"event", "t", ...fields}``) — the structured sibling of
     the reference's scrape-with-regex stream, written as the run progresses
-    so a crashed run still leaves its history on disk.
+    so a crashed run still leaves its history on disk.  JSONL recording is
+    independent of ``verbose``: only the console stream is rank-0-gated,
+    every process keeps its structured history.
     """
 
     def __init__(self, verbose: bool = True, stream: TextIO | None = None,
@@ -33,8 +35,7 @@ class PhaseLogger:
         self.verbose = verbose
         self.stream = stream if stream is not None else sys.stdout
         self.clock = clock
-        self._jsonl = open(jsonl_path, "a") if jsonl_path and verbose \
-            else None
+        self._jsonl = open(jsonl_path, "a") if jsonl_path else None
 
     def _emit(self, line: str) -> None:
         if self.verbose:

@@ -157,7 +157,11 @@ class StepTimer:
         to block on it first (honest step timing)."""
         if sync is not None:
             jax.block_until_ready(sync)
-            self._last = self.clock()
+            # Only fold the sync time into the window when a window is
+            # open: after reset() (no _t0 yet) a sync'd summary must not
+            # plant a _last that would precede the next window's _t0.
+            if self._t0 is not None:
+                self._last = max(self.clock(), self._t0)
         if self._t0 is None or self._last is None or self.measured_steps == 0:
             return {"steps_per_sec": 0.0, "examples_per_sec": 0.0,
                     "seconds": 0.0}
@@ -169,12 +173,13 @@ class StepTimer:
         }
 
 
-def measure_async_overlap(fn: Callable, *args,
-                          warmup: bool = True) -> dict[str, float]:
+def measure_async_overlap(fn: Callable, *args, warmup: bool = True,
+                          **kwargs) -> dict[str, float]:
     """Measure how far ahead of device execution the host can run ``fn``.
 
     Returns ``{"dispatch_s", "total_s", "overlap_fraction"}`` where
-    ``dispatch_s`` is the time for ``fn(*args)`` to *return* (all work
+    ``dispatch_s`` is the time for ``fn(*args, **kwargs)`` to *return*
+    (all work
     enqueued on the devices' async streams) and ``total_s`` the time until
     every array in its result is actually ready.  ``overlap_fraction`` =
     ``1 - dispatch_s / total_s``: close to 1 means the host handed the
@@ -191,9 +196,9 @@ def measure_async_overlap(fn: Callable, *args,
     dispatch asynchrony is.
     """
     if warmup:
-        jax.block_until_ready(fn(*args))
+        jax.block_until_ready(fn(*args, **kwargs))
     t0 = time.perf_counter()
-    out = fn(*args)
+    out = fn(*args, **kwargs)
     t1 = time.perf_counter()
     jax.block_until_ready(out)
     t2 = time.perf_counter()

@@ -341,7 +341,7 @@ def _maybe_checkpointer(config: Config):
 
 
 def _restore_resume(ckpt, state, ckpt_step, start_epoch, resume_batch,
-                    resume_totals, logger, restore_fn=None):
+                    resume_totals, logger, restore_fn=None, telemetry=None):
     """Verified restore for non-elastic ``--resume``.
 
     Integrity fallback: when the requested step is torn/corrupt it is
@@ -349,11 +349,19 @@ def _restore_resume(ckpt, state, ckpt_step, start_epoch, resume_batch,
     resume point is then re-decoded from the step ACTUALLY restored, so
     the loader replay and phase totals stay consistent with the params.
     ``restore_fn`` (same contract as ``restore_verified``) swaps in the
-    resharding restore under ``--reshard``."""
+    resharding restore under ``--reshard``; with ``telemetry`` that case
+    lands in the ``reshard`` span, a plain verified restore in
+    ``recovery`` (the elastic path records its own recovery spans)."""
     from distributed_deep_learning_tpu.train.elastic import resume_point
 
-    restored, used = (restore_fn or ckpt.restore_verified)(state,
-                                                           step=ckpt_step)
+    if telemetry is None:
+        restored, used = (restore_fn or ckpt.restore_verified)(state,
+                                                               step=ckpt_step)
+    else:
+        kind = "reshard" if restore_fn is not None else "recovery"
+        with telemetry.timeline.span(kind):
+            restored, used = (restore_fn or
+                              ckpt.restore_verified)(state, step=ckpt_step)
     if used is None:
         logger.info("checkpoint integrity: no verifiable checkpoint "
                     "survives; starting fresh")
@@ -474,7 +482,8 @@ def _sentinel_config(config: Config):
 
 
 def _fit_elastic(config: Config, logger, make_state, train_step, eval_step,
-                 loaders, ckpt, sentinel=None, restore_fn=None):
+                 loaders, ckpt, sentinel=None, restore_fn=None,
+                 telemetry=None):
     """``--elastic``: checkpointed restart on worker failure or runtime
     error, with optional heartbeat-based liveness detection
     (``--heartbeat-dir``) polled before every step."""
@@ -501,7 +510,8 @@ def _fit_elastic(config: Config, logger, make_state, train_step, eval_step,
                                      monitor=monitor,
                                      checkpoint_every=config.checkpoint_every,
                                      sentinel=sentinel,
-                                     restore_fn=restore_fn)
+                                     restore_fn=restore_fn,
+                                     telemetry=telemetry)
     finally:
         if monitor is not None:
             monitor.stop()
@@ -571,8 +581,8 @@ def _make_1f1b_train_step(mesh, model, loss_fn, state_spec, microbatch,
 
 
 def _run_spmd_pipelined(spec: WorkloadSpec, config: Config, devices, logger,
-                        dataset, splits, example, loss_fn, tx, rng
-                        ) -> tuple[Any, list[EpochResult]]:
+                        dataset, splits, example, loss_fn, tx, rng,
+                        telemetry=None) -> tuple[Any, list[EpochResult]]:
     """`-m pipeline` over the SPMD `stage` axis: one jitted step, stacked
     stage params sharded over `stage`, activations rotated with ppermute —
     replaces MPMD staging for workloads that declare ``build_pipelined``.
@@ -656,6 +666,9 @@ def _run_spmd_pipelined(spec: WorkloadSpec, config: Config, devices, logger,
             interleaved=config.pipeline_schedule == "interleaved")
     loaders = make_loaders(dataset, splits, config.batch_size, mesh,
                            seed=config.seed)
+    if telemetry is not None:
+        _measure_train_flops(telemetry, train_step, state, loaders[0],
+                             n_devices=mesh.size)
     ckpt, ckpt_step, start_epoch, resume_batch, resume_totals = \
         _maybe_checkpointer(config)
     if config.elastic:
@@ -666,11 +679,11 @@ def _run_spmd_pipelined(spec: WorkloadSpec, config: Config, devices, logger,
             return place_state(s, mesh, state_spec)
 
         return _fit_elastic(config, logger, make_state, train_step,
-                            eval_step, loaders, ckpt)
+                            eval_step, loaders, ckpt, telemetry=telemetry)
     if ckpt is not None and ckpt_step is not None:
         state, start_epoch, resume_batch, resume_totals = _restore_resume(
             ckpt, state, ckpt_step, start_epoch, resume_batch,
-            resume_totals, logger)
+            resume_totals, logger, telemetry=telemetry)
     try:
         with profiling.trace(config.profile_dir):
             return fit(state, train_step, eval_step, *loaders,
@@ -678,10 +691,61 @@ def _run_spmd_pipelined(spec: WorkloadSpec, config: Config, devices, logger,
                        checkpointer=ckpt, start_epoch=start_epoch,
                        checkpoint_every=config.checkpoint_every,
                        resume_batch=resume_batch,
-                       resume_totals=resume_totals)
+                       resume_totals=resume_totals, telemetry=telemetry)
     finally:
         if ckpt is not None:
             ckpt.close()
+
+
+# ---------------------------------------------------------------------------
+# Telemetry (obs/) wiring
+# ---------------------------------------------------------------------------
+
+def _maybe_telemetry(config: Config):
+    """``--obs`` → a :class:`..obs.RunTelemetry` for this process.
+
+    Every process records (structured history must survive on every
+    rank, same principle as the PhaseLogger JSONL fix); non-coordinator
+    sidecars get a ``.rankN`` suffix so a shared filesystem holds one
+    stream per process, mergeable offline via
+    ``obs.metrics.merge_snapshots``."""
+    if not config.obs:
+        return None
+    from distributed_deep_learning_tpu.obs import RunTelemetry
+
+    path = config.obs_file or "obs_events.jsonl"
+    if not is_coordinator():
+        path = f"{path}.rank{config.distributed.process_id}"
+    return RunTelemetry(path)
+
+
+def _log_obs_summary(logger, summary: dict) -> None:
+    """One human-readable goodput/MFU line at run end (the full detail
+    lives in the JSONL stream for scripts/obs_report.py)."""
+    gp = summary.get("goodput")
+    if not gp:
+        return
+    fr = gp["fractions"]
+    parts = " ".join(f"{c}={fr[c]:.3f}" for c in
+                     ("productive", "input_stall", "checkpoint",
+                      "recovery", "compile"))
+    mfu = (summary.get("mfu") or {}).get("mfu")
+    mfu_txt = f" mfu={mfu:.4f}" if mfu is not None else ""
+    logger.info(f"obs: goodput {parts} over {gp['wall_seconds']:.1f}s "
+                f"({gp['steps']} steps){mfu_txt}")
+
+
+def _measure_train_flops(telemetry, train_step, state, train_loader,
+                         n_devices: int) -> None:
+    """Peek one batch (the seeded loader replays each epoch's order from
+    ``set_epoch``, so training sees the identical stream afterwards) and
+    record the train step's global per-step FLOPs for MFU."""
+    try:
+        train_loader.set_epoch(1)
+        x, y = next(iter(train_loader))
+    except Exception:
+        return
+    telemetry.measure_flops(train_step, state, x, y, n_devices=n_devices)
 
 
 # ---------------------------------------------------------------------------
@@ -695,6 +759,7 @@ def run_workload(spec: WorkloadSpec, config: Config
     devices = _devices(config)
     logger = PhaseLogger(verbose=is_coordinator(),
                          jsonl_path=config.metrics_file)
+    telemetry = _maybe_telemetry(config)
     if (config.generate_tokens or config.serve) and spec.post_train is None:
         # rejected, not silently dropped (same principle as staged-mode
         # flag validation below)
@@ -738,12 +803,15 @@ def run_workload(spec: WorkloadSpec, config: Config
             # dataset is reused by the search's measured trials
             config = _resolve_plan(spec, config, devices, logger, dataset)
         state, history = _run_workload(spec, config, devices, logger,
-                                       dataset)
+                                       dataset, telemetry=telemetry)
         if (config.generate_tokens or config.serve) and \
                 spec.post_train is not None:
             spec.post_train(config, state, logger, dataset)
         return state, history
     finally:
+        if telemetry is not None:
+            summary = telemetry.close()
+            _log_obs_summary(logger, summary)
         logger.close()
 
 
@@ -803,7 +871,8 @@ def _build_dataset(spec: WorkloadSpec, config: Config):
 
 
 def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
-                  dataset) -> tuple[Any, list[EpochResult]]:
+                  dataset, telemetry=None
+                  ) -> tuple[Any, list[EpochResult]]:
     # DDL_DATA_LIMIT caps the examples considered (CI / smoke runs)
     import os
     limit = int(os.environ.get("DDL_DATA_LIMIT", "0"))
@@ -822,7 +891,8 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
 
     if config.mode is Mode.PIPELINE and spec.build_pipelined is not None:
         return _run_spmd_pipelined(spec, config, devices, logger, dataset,
-                                   splits, example, loss_fn, tx, rng)
+                                   splits, example, loss_fn, tx, rng,
+                                   telemetry=telemetry)
 
     if config.mode in (Mode.SEQUENTIAL, Mode.DATA):
         if config.reshard and config.mode is Mode.DATA:
@@ -887,6 +957,9 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
         state = place_state(state, mesh, state_spec)
         train_step, eval_step = make_train_eval_steps(
             config, mesh, loss_fn, state_spec, sentinel=sentinel)
+        if telemetry is not None:
+            _measure_train_flops(telemetry, train_step, state, loaders[0],
+                                 n_devices=mesh.size)
         ckpt, ckpt_step, start_epoch, resume_batch, resume_totals = \
             _maybe_checkpointer(config)
         restore_fn = None
@@ -912,12 +985,12 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
 
             return _fit_elastic(config, logger, make_state, train_step,
                                 eval_step, loaders, ckpt, sentinel=sentinel,
-                                restore_fn=restore_fn)
+                                restore_fn=restore_fn, telemetry=telemetry)
         if ckpt is not None and ckpt_step is not None:
             state, start_epoch, resume_batch, resume_totals = \
                 _restore_resume(ckpt, state, ckpt_step, start_epoch,
                                 resume_batch, resume_totals, logger,
-                                restore_fn=restore_fn)
+                                restore_fn=restore_fn, telemetry=telemetry)
         try:
             with profiling.trace(config.profile_dir):
                 return fit(state, train_step, eval_step, *loaders,
@@ -925,7 +998,8 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
                            checkpointer=ckpt, start_epoch=start_epoch,
                            checkpoint_every=config.checkpoint_every,
                            resume_batch=resume_batch,
-                           resume_totals=resume_totals, sentinel=sentinel)
+                           resume_totals=resume_totals, sentinel=sentinel,
+                           telemetry=telemetry)
         finally:
             if ckpt is not None:
                 ckpt.close()
@@ -967,4 +1041,5 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
                            seed=config.seed)
     with profiling.trace(config.profile_dir):
         return fit(state, trainer.train_step, trainer.eval_step, *loaders,
-                   epochs=config.epochs, logger=logger)
+                   epochs=config.epochs, logger=logger,
+                   telemetry=telemetry)

@@ -1,0 +1,139 @@
+"""Render a run's obs/ telemetry stream as a human-readable report.
+
+Reads the JSONL event stream a ``--obs`` run writes (goodput breakdowns,
+MFU record, metrics snapshot, serve stats) and prints the production
+questions in plain text: what fraction of wall-clock was productive,
+what stalled the run, what MFU the chips achieved, and what latency
+users saw.
+
+    python scripts/obs_report.py obs_events.jsonl
+    python scripts/obs_report.py obs_events.jsonl --phases   # per-phase too
+    python scripts/obs_report.py obs_events.jsonl --prom     # Prometheus text
+
+``--prom`` dumps the final metrics snapshot in Prometheus text
+exposition format (for a textfile collector or diffing against a scrape
+endpoint) instead of the report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _script_env() -> None:
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _fmt_frac(f: float) -> str:
+    return f"{100.0 * f:5.1f}%"
+
+
+def _goodput_block(gp: dict, indent: str = "  ") -> list[str]:
+    order = ("productive", "input_stall", "checkpoint", "recovery",
+             "compile", "other")
+    lines = [f"{indent}wall {gp['wall_seconds']:.2f}s, "
+             f"{gp['steps']} steps"]
+    for cat in order:
+        frac = gp["fractions"].get(cat, 0.0)
+        sec = gp["seconds"].get(cat, 0.0)
+        bar = "#" * int(round(40 * frac))
+        lines.append(f"{indent}{cat:<12}{_fmt_frac(frac)}  "
+                     f"{sec:8.3f}s  {bar}")
+    return lines
+
+
+def render(events: list[dict], phases: bool = False) -> str:
+    run_gp = None
+    phase_gps = []
+    mfu = None
+    serve = []
+    for ev in events:
+        kind = ev.get("event")
+        if kind == "obs_goodput":
+            if ev.get("scope") == "run":
+                run_gp = ev
+            else:
+                phase_gps.append(ev)
+        elif kind == "obs_mfu":
+            mfu = ev
+        elif kind == "obs_serve":
+            serve.append(ev.get("stats", {}))
+
+    out = []
+    if run_gp is not None:
+        out.append("== goodput (run) ==")
+        out += _goodput_block(run_gp)
+    if phases and phase_gps:
+        for gp in phase_gps:
+            out.append(f"== goodput ({gp.get('scope')}) ==")
+            out += _goodput_block(gp)
+    if mfu is not None:
+        out.append("== model FLOP utilization ==")
+        sps = mfu.get("steps_per_sec")
+        out.append(f"  steps/sec       "
+                   f"{sps:.3f}" if sps else "  steps/sec       n/a")
+        if mfu.get("step_flops"):
+            out.append(f"  step FLOPs      {mfu['step_flops']:.3e} "
+                       f"(x{mfu.get('n_devices')} "
+                       f"{mfu.get('device_kind')})")
+        if mfu.get("achieved_flops_per_sec"):
+            out.append(f"  achieved FLOP/s {mfu['achieved_flops_per_sec']:.3e}")
+        if mfu.get("mfu") is not None:
+            out.append(f"  MFU             {100.0 * mfu['mfu']:.2f}% "
+                       f"(peak {mfu['peak_flops_per_chip']:.3e}/chip)")
+        else:
+            out.append("  MFU             n/a (no peak-FLOPs table entry "
+                       "for this device; set DDL_OBS_PEAK_FLOPS)")
+    for st in serve:
+        lat = st.get("latency") or {}
+        out.append("== serving latency ==")
+        out.append(f"  requests {st.get('requests')}  "
+                   f"tokens/sec {st.get('tokens_per_sec'):.1f}  "
+                   f"occupancy {st.get('mean_slot_occupancy'):.2f}"
+                   f"/{st.get('max_slots')}")
+        if lat.get("measured_requests"):
+            out.append(f"  ttft  p50 {1e3 * lat['ttft_p50_s']:8.2f}ms   "
+                       f"p99 {1e3 * lat['ttft_p99_s']:8.2f}ms")
+            out.append(f"  itl   p50 {1e3 * lat['itl_p50_s']:8.2f}ms   "
+                       f"p99 {1e3 * lat['itl_p99_s']:8.2f}ms")
+            out.append(f"  e2e   p50 {lat['e2e_p50_s']:8.3f}s    "
+                       f"p99 {lat['e2e_p99_s']:8.3f}s")
+    if not out:
+        out.append("no obs events found (was the run started with --obs?)")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="render an --obs telemetry stream as a goodput/MFU/"
+                    "latency report")
+    p.add_argument("stream", help="JSONL event file written by --obs")
+    p.add_argument("--phases", action="store_true",
+                   help="also print per-phase goodput breakdowns")
+    p.add_argument("--prom", action="store_true",
+                   help="dump the final metrics snapshot as Prometheus "
+                        "text exposition instead of the report")
+    args = p.parse_args(argv)
+
+    from distributed_deep_learning_tpu.obs.export import (prometheus_text,
+                                                          read_events)
+
+    events = list(read_events(args.stream))
+    if args.prom:
+        snaps = [e for e in events if e.get("event") == "obs_snapshot"]
+        if not snaps:
+            print("no obs_snapshot event in the stream", file=sys.stderr)
+            return 1
+        sys.stdout.write(prometheus_text(snaps[-1]["snapshot"]))
+        return 0
+    print(render(events, phases=args.phases))
+    return 0
+
+
+if __name__ == "__main__":
+    _script_env()
+    sys.exit(main())

@@ -5,9 +5,13 @@ distributions, optional staggered arrivals) through the slot-based
 continuous-batching engine (``serve/engine.py``) AND the batch-
 synchronous run-to-completion ``generate()`` baseline, then prints ONE
 JSON line: tokens/sec for both paths, the speedup, the engine's
-prefill/decode time split, mean slot occupancy, and per-path compile
+prefill/decode time split, mean slot occupancy, per-path compile
 counts (the engine's decode program compiles ONCE for the whole trace;
-the naive path recompiles per ``(B, P, max_new)`` shape).
+the naive path recompiles per ``(B, P, max_new)`` shape), and the
+engine's per-request latency percentiles (p50/p99 TTFT, inter-token,
+end-to-end — from the obs/ histogram machinery, TTFT anchored at the
+request's arrival so queue wait counts).  A human-readable latency
+summary line goes to stderr; stdout stays one JSON line.
 
     JAX_PLATFORMS=cpu python scripts/serve_bench.py            # defaults
     python scripts/serve_bench.py --requests 64 --max-slots 16 \
@@ -77,6 +81,16 @@ def main(argv=None) -> int:
         stagger=args.stagger, skip_naive=args.skip_naive)
     out = json.dumps(record)
     print(out)
+    lat = record["engine"].get("latency") or {}
+    if lat.get("measured_requests"):
+        print(f"latency over {lat['measured_requests']} requests: "
+              f"ttft p50={lat['ttft_p50_s'] * 1e3:.1f}ms "
+              f"p99={lat['ttft_p99_s'] * 1e3:.1f}ms | "
+              f"itl p50={lat['itl_p50_s'] * 1e3:.2f}ms "
+              f"p99={lat['itl_p99_s'] * 1e3:.2f}ms | "
+              f"e2e p50={lat['e2e_p50_s']:.3f}s "
+              f"p99={lat['e2e_p99_s']:.3f}s",
+              file=sys.stderr)
     if args.out:
         with open(args.out, "w") as f:
             f.write(out + "\n")

@@ -413,6 +413,53 @@ def reshard():
             **(_reshard() or {})}
 
 
+def observability(steps_hint=10):
+    """Unified telemetry e2e on real hardware: a short ``--obs`` training
+    run, then harvest the goodput breakdown + MFU straight from the
+    emitted JSONL stream — the numbers PERFORMANCE.md §Observability
+    records.  On TPU the MFU field is live (the chip is in the peak
+    table); on CPU smoke it exercises the same path via
+    ``DDL_OBS_PEAK_FLOPS``.  Also runs the instrumentation-overhead A/B
+    (the <2% acceptance bar) on this box."""
+    import tempfile
+
+    import jax
+
+    from distributed_deep_learning_tpu.obs.bench import overhead_bench
+    from distributed_deep_learning_tpu.obs.export import read_events
+    from distributed_deep_learning_tpu.utils.config import parse_args
+    from distributed_deep_learning_tpu.workloads import (get_spec,
+                                                         run_workload)
+
+    on_tpu = jax.default_backend() == "tpu"
+    os.environ.setdefault("DDL_DATA_LIMIT", "512" if on_tpu else "256")
+    if not on_tpu:
+        # exercise the full MFU path on the smoke box (arbitrary peak)
+        os.environ.setdefault("DDL_OBS_PEAK_FLOPS", "1e12")
+    stream = os.path.join(tempfile.mkdtemp(prefix="obs_val_"),
+                          "obs_events.jsonl")
+    argv = ["-e", "2", "-b", "64" if on_tpu else "32", "-m", "data",
+            "--obs", "--obs-file", stream]
+    run_workload(get_spec("mlp"), parse_args(argv, workload="mlp"))
+
+    events = list(read_events(stream))
+    run_gp = next((e for e in events if e.get("event") == "obs_goodput"
+                   and e.get("scope") == "run"), {})
+    mfu = next((e for e in events if e.get("event") == "obs_mfu"), {})
+    return {
+        "section": "observability", "on_tpu": on_tpu,
+        "goodput_fractions": run_gp.get("fractions"),
+        "wall_seconds": run_gp.get("wall_seconds"),
+        "steps": run_gp.get("steps"),
+        "mfu": mfu.get("mfu"),
+        "steps_per_sec": mfu.get("steps_per_sec"),
+        "step_flops": mfu.get("step_flops"),
+        "device_kind": mfu.get("device_kind"),
+        "overhead": overhead_bench(
+            steps=48, repeats=5 if on_tpu else 3),
+    }
+
+
 def _record_flash_gate(result: dict) -> None:
     """Persist the measured ratio as the `--attention auto` gate datum."""
     from distributed_deep_learning_tpu.utils.bench_records import (
@@ -423,7 +470,8 @@ def _record_flash_gate(result: dict) -> None:
 
 SECTIONS = ("flash_block_sweep", "flash_vs_dense", "gqa_speedup",
             "s2d_vs_plain", "batch_sweep", "lm_tokens", "serving",
-            "autotune", "reshard", "mfu_diag", "lm_sweep")
+            "autotune", "reshard", "observability", "mfu_diag",
+            "lm_sweep")
 
 
 def _run_section(name: str) -> None:
