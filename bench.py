@@ -389,13 +389,22 @@ def _serving() -> dict | None:
     both run for real on the CI box; the TPU-shaped harvest lives in
     ``scripts/tpu_validation.py``'s ``serving`` section).  Reports
     tokens/sec both ways, the speedup, mean slot occupancy, and compile
-    counts (decode must be 1 — the compile-once contract)."""
-    from distributed_deep_learning_tpu.serve.bench import serving_bench
+    counts (decode must be 1 — the compile-once contract).
+
+    The paged second generation (ISSUE 9) rides in the same section: a
+    trace-driven SLO load (shared system prompts, Poisson arrivals,
+    per-request deadlines) through the paged engine with a 1-layer
+    speculative draft, A/B'd against the v1 engine on the same trace.
+    Its three headline numbers — ``prefix_hit_rate``,
+    ``slo_attainment``, ``spec_acceptance`` — are lifted to the top of
+    the record for baseline tracking (``cpu:serving_*_v1``)."""
+    from distributed_deep_learning_tpu.serve.bench import (
+        paged_serving_bench, serving_bench)
 
     n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", 32))
     slots = int(os.environ.get("BENCH_SERVE_SLOTS", 8))
     rec = serving_bench(n_requests=n_req, max_slots=slots)
-    return {
+    out = {
         "metric": "serving tokens/sec (mixed-length trace)",
         "engine_tokens_per_sec": rec["engine"]["tokens_per_sec"],
         "naive_tokens_per_sec": rec["naive"]["tokens_per_sec"],
@@ -408,6 +417,28 @@ def _serving() -> dict | None:
         "max_slots": slots,
         "requests": n_req,
     }
+    p_req = int(os.environ.get("BENCH_SERVE_PAGED_REQUESTS", 12))
+    draft = int(os.environ.get("BENCH_SERVE_DRAFT", 1))
+    prec = paged_serving_bench(load_kw=dict(n_requests=p_req),
+                               max_slots=slots,
+                               draft_layers=draft or None)
+    pe = prec["paged_engine"]
+    out["paged"] = {
+        "tokens_per_sec": pe["tokens_per_sec"],
+        "speedup_vs_v1": prec.get("speedup_vs_v1"),
+        "prefill_tokens_saved_frac": prec.get("prefill_tokens_saved_frac"),
+        "cow_copies": pe["paged"]["cow_copies"],
+        "chunk_compiles": pe["chunk_compiles"],
+        "decode_compiles": pe["decode_compiles"],
+        "verify_compiles": pe["verify_compiles"],
+        "requests": p_req,
+        "draft_layers": draft or None,
+    }
+    out["prefix_hit_rate"] = round(pe["prefix_hit_rate"], 4)
+    out["slo_attainment"] = pe["slo_attainment"]
+    out["spec_acceptance"] = round(pe["spec_acceptance"], 4) \
+        if pe["spec_acceptance"] is not None else None
+    return out
 
 
 def _resilience() -> dict | None:
@@ -835,6 +866,20 @@ def main() -> None:
                                f"{platform}:serving_tokens_per_sec_v1",
                                serving["engine_tokens_per_sec"], base_path)
             serving["vs_baseline"] = round(svs, 4)
+            # paged-generation headline numbers (ISSUE 9): hit rate and
+            # SLO attainment regress toward 0, so a ratio < 1 flags them
+            # the same way a throughput drop would
+            for bkey, val in (
+                    ("serving_prefix_hit_rate_v1",
+                     serving.get("prefix_hit_rate")),
+                    ("serving_slo_attainment_v1",
+                     serving.get("slo_attainment")),
+                    ("serving_spec_acceptance_v1",
+                     serving.get("spec_acceptance"))):
+                if val is not None:
+                    serving[bkey.replace("_v1", "_vs_baseline")] = round(
+                        _vs_baseline(baselines, f"{platform}:{bkey}",
+                                     val, base_path), 4)
         except Exception as exc:
             print(f"bench: serving section failed "
                   f"({type(exc).__name__}: {exc})", file=sys.stderr)

@@ -9,10 +9,23 @@ requests of any length enter and leave slots without changing a shape.
 :mod:`.bench` drives mixed-length request traces through the engine and
 the naive run-to-completion :func:`..models.transformer.generate`
 baseline.
+
+Second generation, same discipline, planet-scale tricks:
+:class:`.engine.PagedEngine` serves from block pools (:mod:`.paged` —
+refcounted paged KV with rolling-hash prefix reuse and copy-on-write),
+prefills in fixed chunks interleaved with decode (:mod:`.prefill`),
+optionally speculates with a truncated-layer draft verified in one
+batched forward (:mod:`.spec`), and is driven by replayable traces with
+per-request SLOs (:mod:`.load`).
 """
 
-from distributed_deep_learning_tpu.serve.engine import ServeEngine
-from distributed_deep_learning_tpu.serve.scheduler import (Request,
+from distributed_deep_learning_tpu.serve.engine import (PagedEngine,
+                                                        ServeEngine)
+from distributed_deep_learning_tpu.serve.load import (LoadSpec, make_load,
+                                                      slo_report)
+from distributed_deep_learning_tpu.serve.scheduler import (PagedScheduler,
+                                                           Request,
                                                            SlotScheduler)
 
-__all__ = ["ServeEngine", "Request", "SlotScheduler"]
+__all__ = ["ServeEngine", "PagedEngine", "Request", "SlotScheduler",
+           "PagedScheduler", "LoadSpec", "make_load", "slo_report"]

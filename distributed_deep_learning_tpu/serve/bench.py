@@ -25,6 +25,13 @@ mean slot occupancy so the mechanism is visible, not just the ratio.
 Shared by ``scripts/serve_bench.py`` (CLI), ``bench.py`` (the
 ``serving`` sub-record) and ``scripts/tpu_validation.py`` (the TPU
 harvest section).
+
+:func:`paged_serving_bench` is the second-generation bench: a
+trace-driven SLO load (:mod:`..serve.load` — Poisson/bursty arrivals,
+shared system prompts, per-request deadlines) through
+:class:`..serve.engine.PagedEngine`, A/B'd against the v1 engine on the
+same trace.  Load shapes live HERE (``DEFAULT_LOAD``) so every caller
+benches the same story.
 """
 
 from __future__ import annotations
@@ -35,13 +42,27 @@ from typing import Optional, Sequence
 import numpy as np
 
 from distributed_deep_learning_tpu.serve.engine import (CountingJit,
+                                                        PagedEngine,
                                                         ServeEngine)
+from distributed_deep_learning_tpu.serve.load import LoadSpec, make_load
 from distributed_deep_learning_tpu.serve.scheduler import Request
 
 #: CPU-CI-sized default model geometry (big enough that a decode tick is
 #: real compute, small enough that the whole A/B fits a bench section)
 DEFAULT_MODEL = dict(vocab_size=512, num_layers=2, d_model=128,
                      num_heads=4, mlp_dim=256, max_len=160)
+
+#: default trace for the PAGED bench — the serving story in one load
+#: shape: Poisson arrivals, bimodal prompt lengths, 60% of requests
+#: opening with one shared 32-token system prompt (the prefix cache's
+#: target), per-request TTFT/e2e SLOs.  ONE place defines it; the CLI
+#: (scripts/serve_bench.py), bench.py and tpu_validation.py all override
+#: fields of this dict rather than re-rolling their own traces.
+DEFAULT_LOAD = dict(n_requests=24, arrival="poisson", rate=2.0,
+                    prompt_short=(4, 16), prompt_long=(40, 72),
+                    long_frac=0.3, shared_prefix_len=32, shared_frac=0.6,
+                    new_tokens=(4, 32), slo_ttft_ms=2000.0,
+                    slo_e2e_ms=15000.0)
 
 
 def build_model(seed: int = 0, **overrides):
@@ -191,4 +212,121 @@ def serving_bench(*, seed: int = 0, n_requests: int = 32,
         record["speedup"] = round(
             es["tokens_per_sec"] / ns["tokens_per_sec"], 3) \
             if ns["tokens_per_sec"] else None
+    return record
+
+
+def run_paged(model, params, requests: Sequence[Request], telemetry=None,
+              keep_timeline: bool = False, **engine_kw):
+    """One :class:`PagedEngine` lifetime over the trace (same contract
+    as :func:`run_engine`)."""
+    eng = PagedEngine(model, params, **engine_kw)
+    return eng.run(requests, telemetry=telemetry,
+                   keep_timeline=keep_timeline)
+
+
+def paged_max_len(model_max_len: int, kv_block_size: int,
+                  draft: bool, spec_k: int) -> int:
+    """Largest engine ``max_len`` a model geometry supports: the paged
+    cache rounds capacity up to whole blocks and, with speculation on,
+    needs ``spec_k + 1`` positions of verify headroom — all of which
+    must still fit the model's learned position range."""
+    head = (spec_k + 1) if draft else 0
+    cap = (model_max_len // kv_block_size) * kv_block_size - head
+    if cap < kv_block_size:
+        raise ValueError(
+            f"model max_len {model_max_len} too small for block size "
+            f"{kv_block_size} (+{head} speculative headroom)")
+    return cap
+
+
+def paged_serving_bench(*, seed: int = 0,
+                        load_kw: Optional[dict] = None,
+                        model_kw: Optional[dict] = None,
+                        max_slots: int = 8,
+                        kv_block_size: int = 16,
+                        prefill_chunk: int = 32,
+                        draft_layers: Optional[int] = None,
+                        spec_k: int = 4,
+                        compare_engine: bool = True,
+                        telemetry=None) -> dict:
+    """The paged-generation bench: one trace-driven load (``DEFAULT_LOAD``
+    overridden by ``load_kw``) through :class:`PagedEngine`, optionally
+    A/B'd against the v1 :class:`ServeEngine` on the SAME trace.
+
+    The record carries the three fields the CI baseline tracks —
+    ``prefix_hit_rate``, ``slo_attainment``, ``spec_acceptance`` — plus
+    the mechanism counters (chunk/verify compiles, CoW copies,
+    evictions, prefill tokens computed) that explain them.  The v1
+    comparison reports ``prefill_tokens_saved_frac``: v1 prefills every
+    prompt to its padded bucket; the paged path prefills only
+    unshared tokens, in chunks.
+    """
+    model, params = build_model(seed, **(model_kw or {}))
+    spec = LoadSpec(**{**DEFAULT_LOAD, **(load_kw or {})})
+    cap = paged_max_len(model.max_len, kv_block_size,
+                        draft_layers is not None, spec_k)
+    need = spec.shared_prefix_len + spec.prompt_long[1] + spec.new_tokens[1]
+    if need > cap:
+        raise ValueError(
+            f"trace upper bound {need} tokens exceeds paged capacity "
+            f"{cap} (model max_len {model.max_len})")
+    trace = make_load(spec, vocab_size=model.vocab_size, seed=seed)
+
+    res = run_paged(model, params, trace, telemetry=telemetry,
+                    max_slots=max_slots, max_len=cap,
+                    kv_block_size=kv_block_size,
+                    prefill_chunk=min(prefill_chunk, cap),
+                    draft_layers=draft_layers, spec_k=spec_k)
+    ps = res["stats"]
+    record = {
+        "metric": "paged serving under trace-driven SLO load",
+        "model": {**DEFAULT_MODEL, **(model_kw or {})},
+        "load": {**DEFAULT_LOAD, **(load_kw or {})},
+        "max_slots": max_slots,
+        "kv_block_size": kv_block_size,
+        "prefill_chunk": prefill_chunk,
+        "errors": len(res["errors"]),
+        "paged_engine": {
+            "tokens_per_sec": round(ps["tokens_per_sec"], 2),
+            "prefill_seconds": round(ps["prefill_seconds"], 3),
+            "decode_seconds": round(ps["decode_seconds"], 3),
+            "mean_slot_occupancy": round(ps["mean_slot_occupancy"], 3),
+            "prefill_chunks": ps["prefill_chunks"],
+            "decode_ticks": ps["decode_ticks"],
+            "chunk_compiles": ps["chunk_compiles"],
+            "decode_compiles": ps["decode_compiles"],
+            "verify_compiles": ps["verify_compiles"],
+            "draft_compiles": ps["draft_compiles"],
+            # the three baseline-tracked headline numbers
+            "prefix_hit_rate": ps["paged"]["prefix_hit_rate"],
+            "slo_attainment": ps["slo"]["slo_attainment"],
+            "spec_acceptance": ps["spec"]["acceptance_rate"],
+            "paged": ps["paged"],
+            "spec": ps["spec"],
+            "slo": ps["slo"],
+            "latency": {k: (round(v, 5) if isinstance(v, float) else v)
+                        for k, v in ps["latency"].items()},
+        },
+    }
+    if compare_engine:
+        v1 = run_engine(model, params, trace, max_slots=max_slots)
+        vs = v1["stats"]
+        # v1 prefills each admitted prompt to its padded compile bucket
+        buckets = vs["buckets"]
+        v1_prefill = sum(min(b for b in buckets if b >= len(r.prompt))
+                         for r in trace if r.uid not in v1["errors"])
+        record["engine_v1"] = {
+            "tokens_per_sec": round(vs["tokens_per_sec"], 2),
+            "prefill_seconds": round(vs["prefill_seconds"], 3),
+            "prefill_compiles": vs["prefill_compiles"],
+            "prefill_tokens_computed": v1_prefill,
+            "latency": {k: (round(v, 5) if isinstance(v, float) else v)
+                        for k, v in vs["latency"].items()},
+        }
+        if v1_prefill:
+            record["prefill_tokens_saved_frac"] = round(
+                1 - ps["paged"]["prefill_tokens_computed"] / v1_prefill, 4)
+        if vs["tokens_per_sec"]:
+            record["speedup_vs_v1"] = round(
+                ps["tokens_per_sec"] / vs["tokens_per_sec"], 3)
     return record

@@ -40,7 +40,14 @@ from distributed_deep_learning_tpu.models.transformer import (
     validate_sampling)
 from distributed_deep_learning_tpu.obs.metrics import MetricsRegistry
 from distributed_deep_learning_tpu.serve import cache as slot_cache
-from distributed_deep_learning_tpu.serve.scheduler import (Request,
+from distributed_deep_learning_tpu.serve import paged
+from distributed_deep_learning_tpu.serve import spec as spec_mod
+from distributed_deep_learning_tpu.serve.load import slo_report
+from distributed_deep_learning_tpu.serve.prefill import (chunk_tokens,
+                                                         plan_chunks,
+                                                         write_targets)
+from distributed_deep_learning_tpu.serve.scheduler import (PagedScheduler,
+                                                           Request,
                                                            SlotScheduler)
 
 
@@ -324,3 +331,600 @@ class ServeEngine:
         if telemetry is not None:
             telemetry.writer.emit("obs_serve", stats=stats)
         return {"results": sched.finished, "errors": errors, "stats": stats}
+
+
+class PagedEngine:
+    """Paged continuous batching: prefix reuse, chunked prefill,
+    speculative decoding — identical greedy outputs, fewer FLOPs.
+
+    The three classic serving optimizations, mapped onto the same
+    compile-once discipline as :class:`ServeEngine`:
+
+    * **Paged KV with prefix reuse** (:mod:`.paged`) — cache leaves live
+      in fixed-size block pools; each slot holds a block TABLE.  A
+      rolling chain hash over token-prefix chunks indexes committed
+      blocks, so a request whose prompt prefix was served before
+      references those blocks instead of recomputing them (refcounted;
+      copy-on-write the moment it diverges mid-block).  Tables and
+      positions are device DATA, so program shapes never change.
+    * **Chunked prefill** (:mod:`.prefill`) — prompts land in fixed-size
+      chunks interleaved with decode ticks under a per-tick budget, so
+      one long prompt stalls live streams by at most ~one chunk of
+      compute instead of a whole prompt.
+    * **Speculative decoding** (:mod:`.spec`) — a truncated-layer draft
+      sharing the target's weights proposes ``spec_k`` tokens per round;
+      the target scores all ``spec_k + 1`` positions in ONE batched
+      cached forward and keeps the longest greedy-matching prefix.
+      Greedy parity is exact (see :func:`.spec.greedy_accept`); only
+      the forward count changes.
+
+    Each device program (chunk prefill, decode, draft propose, verify,
+    draft chunk, block copy) runs through :class:`CountingJit` and
+    compiles exactly ONCE for the engine's lifetime — asserted by
+    tests, not assumed.  The block pools, prefix index, and compiled
+    programs persist across ``run()`` calls, so a later trace sharing
+    prompts with an earlier one starts with a warm prefix cache.
+    """
+
+    def __init__(self, model: CausalLM, params, *, max_slots: int = 8,
+                 max_len: Optional[int] = None, kv_block_size: int = 16,
+                 num_blocks: Optional[int] = None, prefill_chunk: int = 32,
+                 prefill_chunks_per_tick: int = 1,
+                 draft_layers: Optional[int] = None, spec_k: int = 4,
+                 eos_id: Optional[int] = None, temperature: float = 0.0,
+                 top_k: Optional[int] = None, top_p: Optional[float] = None,
+                 rng=None, donate: Optional[bool] = None):
+        validate_sampling(top_k, top_p)
+        self.model, self.params = model, params
+        self.lm = make_decode_model(model)
+        self.max_slots = int(max_slots)
+        if self.max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        self.max_len = int(max_len if max_len is not None else model.max_len)
+        self.eos_id = eos_id
+        self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
+        self.pad_fill = model.pad_id if model.pad_id is not None else 0
+        self._key = rng if rng is not None else jax.random.key(0)
+
+        bs = int(kv_block_size)
+        if bs < 1:
+            raise ValueError(f"kv_block_size must be >= 1, got {bs}")
+        self.block_size = bs
+        self.chunk = int(prefill_chunk)
+        if self.chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got "
+                             f"{prefill_chunk}")
+        self.chunks_per_tick = max(1, int(prefill_chunks_per_tick))
+
+        self.spec_k = int(spec_k)
+        self.draft_layers = draft_layers
+        if draft_layers is not None:
+            if temperature != 0.0:
+                raise ValueError("speculative decoding is greedy-only "
+                                 "(acceptance is exact-match against the "
+                                 "target argmax); set temperature=0")
+            if self.spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        # speculation writes up to spec_k positions past the stream tip,
+        # so the slot's logical buffer gets that much headroom on top of
+        # the serving cap, rounded up to whole blocks
+        headroom = (self.spec_k + 1) if draft_layers is not None else 0
+        self.padded_len = -(-(self.max_len + headroom) // bs) * bs
+        if self.padded_len > model.max_len:
+            raise ValueError(
+                f"slot buffer {self.padded_len} (max_len {self.max_len} + "
+                f"speculative headroom {headroom}, in whole blocks) "
+                f"exceeds the model's max_len {model.max_len}; lower "
+                f"max_len or spec_k")
+        if self.chunk > self.padded_len:
+            raise ValueError(f"prefill_chunk {self.chunk} exceeds the "
+                             f"slot buffer {self.padded_len}")
+        self.blocks_per_slot = self.padded_len // bs
+        if num_blocks is None:
+            # 1x for the live slots + 1x retention headroom so the
+            # prefix index can keep blocks alive after their request
+            num_blocks = 2 * self.max_slots * self.blocks_per_slot
+        self.manager = paged.BlockManager(num_blocks, bs, self.max_slots,
+                                          self.blocks_per_slot)
+        if donate is None:
+            donate = jax.default_backend() != "cpu"
+        dk = {"donate_argnums": (1,)} if donate else {}
+        ck = {"donate_argnums": (0,)} if donate else {}
+        self.pools = paged.build_pools(self.lm, num_blocks + 1, bs,
+                                       self.padded_len)
+        self._chunk_prog = CountingJit(self._chunk_impl, **dk)
+        self._decode = CountingJit(self._decode_impl, **dk)
+        self._copy = CountingJit(self._copy_impl, **ck)
+        if draft_layers is not None:
+            self.draft_lm, self.draft_params = spec_mod.truncated_draft(
+                self.lm, params, draft_layers)
+            self.draft_pools = paged.build_pools(self.draft_lm,
+                                                 num_blocks + 1, bs,
+                                                 self.padded_len)
+            self._draft = CountingJit(self._draft_impl, **dk)
+            self._verify = CountingJit(self._verify_impl, **dk)
+            self._draft_chunk = CountingJit(self._draft_chunk_impl, **dk)
+            self._draft_copy = CountingJit(self._draft_copy_impl, **ck)
+
+    # --- compiled programs (each traces exactly once) ---------------------
+    def _sample(self, hidden_last, key):
+        return sample_tokens(self.model, self.params, hidden_last, key,
+                             temperature=self.temperature,
+                             top_k=self.top_k, top_p=self.top_p)
+
+    def _chunk_impl(self, params, pools, tokens, table, pos, logit_idx,
+                    wb, wo, key):
+        """One prefill chunk for one slot: gather its logical cache,
+        run the chunk through the model's multi-token cached forward,
+        scatter the fresh KV span to its blocks (already-committed /
+        padding positions routed to trash), and sample at ``logit_idx``
+        (meaningful on the final chunk only — the caller ignores it
+        otherwise; the extra 1-row head projection is noise)."""
+        cache = paged.gather_slot(pools, table, pos)
+        hidden, new = cached_apply(self.lm, params, cache, tokens[None])
+        span = paged.extract_span(new, pos, self.chunk)
+        pools = paged.scatter_span(pools, span, wb, wo)
+        h_last = jax.lax.dynamic_slice_in_dim(hidden[0], logit_idx, 1)
+        tok, _ = self._sample(h_last, key)
+        return pools, tok[0]
+
+    def _draft_chunk_impl(self, dparams, dpools, tokens, table, pos,
+                          wb, wo):
+        """The draft model's KV for the same chunk — speculation needs
+        the draft's cache warm over the whole committed stream."""
+        cache = paged.gather_slot(dpools, table, pos)
+        _, new = cached_apply(self.draft_lm, dparams, cache, tokens[None])
+        span = paged.extract_span(new, pos, self.chunk)
+        return paged.scatter_span(dpools, span, wb, wo)
+
+    def _decode_impl(self, params, pools, tables, positions, toks,
+                     wb, wo, key):
+        """One token for every slot: gather each slot's logical cache
+        from the pools, run the model's single-sequence cached decode
+        (vmapped), scatter each slot's new KV position back, one shared
+        sampling.  Free/prefilling slots run on garbage and write to
+        trash; their sampled tokens are ignored by the host."""
+        def one(table, pos, tok):
+            cache = paged.gather_slot(pools, table, pos)
+            hidden, new = cached_apply(self.lm, params, cache,
+                                       tok[None, None])
+            return hidden[0, 0], paged.extract_span(new, pos, 1)
+
+        h, spans = jax.vmap(one)(tables, positions, toks)
+        kv = jax.tree_util.tree_map_with_path(
+            lambda p, x: x if paged.is_counter(p) else x[:, 0], spans)
+        pools = paged.scatter_span(pools, kv, wb, wo)
+        toks, _ = self._sample(h, key)
+        return pools, toks
+
+    def _draft_impl(self, dparams, dpools, tables, positions, toks,
+                    wb, wo):
+        """Draft proposal round: ``spec_k + 1`` greedy cached steps per
+        slot (scan), writing draft KV at positions ``c .. c+k``.  The
+        extra step exists to WRITE position ``c+k`` (its proposal is
+        discarded) so an all-accept round leaves no KV hole."""
+        T = self.spec_k + 1
+
+        def one(table, pos, tok):
+            cache = paged.gather_slot(dpools, table, pos)
+
+            def step(carry, _):
+                c, t = carry
+                hidden, c = cached_apply(self.draft_lm, dparams, c,
+                                         t[None, None])
+                nxt, _ = sample_tokens(self.draft_lm, dparams,
+                                       hidden[0, 0][None],
+                                       jax.random.key(0), temperature=0.0)
+                nt = nxt[0].astype(t.dtype)
+                return (c, nt), nt
+
+            (cache, _), outs = jax.lax.scan(step, (cache, tok), None,
+                                            length=T)
+            return outs, paged.extract_span(cache, pos, T)
+
+        outs, spans = jax.vmap(one)(tables, positions, toks)
+        dpools = paged.scatter_span(dpools, spans, wb, wo)
+        return dpools, outs[:, :self.spec_k]
+
+    def _verify_impl(self, params, pools, tables, positions, toks, wb, wo):
+        """Target verification: ONE batched ``spec_k + 1``-token cached
+        forward per slot scores the pending token plus every draft
+        proposal; returns the target's greedy choice at each position.
+        This is the whole speedup: ``a + 1`` tokens per target forward
+        instead of 1."""
+        T = self.spec_k + 1
+
+        def one(table, pos, tk):
+            cache = paged.gather_slot(pools, table, pos)
+            hidden, new = cached_apply(self.lm, params, cache, tk[None])
+            return hidden[0], paged.extract_span(new, pos, T)
+
+        h, spans = jax.vmap(one)(tables, positions, toks)
+        pools = paged.scatter_span(pools, spans, wb, wo)
+        g, _ = self._sample(h.reshape(-1, h.shape[-1]), jax.random.key(0))
+        return pools, g.reshape(tables.shape[0], T)
+
+    def _copy_impl(self, pools, src, dst):
+        return paged.copy_block(pools, src, dst)
+
+    def _draft_copy_impl(self, dpools, src, dst):
+        return paged.copy_block(dpools, src, dst)
+
+    # --- host side --------------------------------------------------------
+    def _cow(self, src: int, dst: int) -> None:
+        """Device half of copy-on-write: duplicate the physical block in
+        the target pools (and the draft pools, whose tables are shared,
+        when speculation is on)."""
+        s, d = np.int32(src), np.int32(dst)
+        self.pools = self._copy(self.pools, s, d)
+        if self.draft_layers is not None:
+            self.draft_pools = self._draft_copy(self.draft_pools, s, d)
+
+    def _make_writable(self, idx: int, lo_pos: int, hi_pos: int) -> None:
+        """Run the manager's COW check over every logical block touched
+        by positions ``[lo_pos, hi_pos]`` BEFORE computing scatter
+        targets (the check may swap table entries)."""
+        for lg in range(lo_pos // self.block_size,
+                        hi_pos // self.block_size + 1):
+            pair = self.manager.writable(idx, lg)
+            if pair is not None:
+                self._cow(*pair)
+
+    def _validate(self, req: Request) -> None:
+        if len(req.prompt) + req.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"request {req.uid}: prompt {len(req.prompt)} + "
+                f"{req.max_new_tokens} new tokens exceeds the serving "
+                f"capacity max_len={self.max_len}")
+
+    def _capacity_len(self, req: Request) -> int:
+        """Stream positions a request may ever write — its whole block
+        budget, reserved at admission (which is why the pool cannot
+        deadlock: an admitted request never waits for blocks)."""
+        extra = (self.spec_k + 1) if self.draft_layers is not None else 0
+        return min(len(req.prompt) + req.max_new_tokens + extra,
+                   self.padded_len)
+
+    def _next_key(self):
+        if self.temperature == 0.0:
+            return self._key           # unused by greedy sampling
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def run(self, requests: Iterable[Request], telemetry=None,
+            keep_timeline: bool = False) -> dict:
+        """Serve a trace; returns ``{"results", "errors", "stats"}``
+        (plus ``"timeline"`` when ``keep_timeline`` — one dict per tick
+        with ``placed``/``chunks``/``decoded`` uid lists, the record the
+        fairness and stall-bound tests assert on).
+
+        ``stats`` carries the v1 throughput/latency accounting plus
+        ``paged`` (block pool + prefix hit rate), ``spec`` (acceptance),
+        and ``slo`` (attainment from per-request SLOs) sub-records.
+        """
+        sched = PagedScheduler(self.max_slots)
+        mgr = self.manager
+        bs = self.block_size
+        n_req = 0
+        errors: dict[int, str] = {}
+        accepted: list[Request] = []
+        for req in requests:
+            try:
+                self._validate(req)
+            except ValueError as e:
+                errors[req.uid] = str(e)
+                continue
+            sched.submit(req)
+            accepted.append(req)
+            n_req += 1
+
+        reg = telemetry.registry if telemetry is not None \
+            else MetricsRegistry()
+        h_ttft = reg.histogram("serve_ttft_seconds")
+        h_itl = reg.histogram("serve_intertoken_seconds")
+        h_e2e = reg.histogram("serve_e2e_seconds")
+        h_tick = reg.histogram("serve_decode_tick_seconds")
+        h_chunks = reg.histogram("serve_chunks_per_tick")
+        h_accept = reg.histogram("serve_spec_acceptance")
+        g_queue = reg.gauge("serve_queue_depth")
+        g_occ = reg.gauge("serve_slot_occupancy")
+        g_blocks = reg.gauge("serve_kv_blocks_in_use")
+        g_hit = reg.gauge("serve_prefix_hit_rate")
+
+        # per-slot host state: the token stream (prompt + emitted), how
+        # many positions hold committed KV, remaining chunk plans, and
+        # the pending token (emitted, not yet fed)
+        stream: dict[int, list] = {}
+        committed: dict[int, int] = {}
+        plans: dict[int, list] = {}
+        pendtok: dict[int, int] = {}
+        first_wall: dict[int, float] = {}
+        ttft_s: dict[int, float] = {}
+        e2e_s: dict[int, float] = {}
+        timeline = [] if keep_timeline else None
+
+        shared_tokens = prompt_tokens = 0
+        chunk_calls = spec_rounds = proposed_total = accepted_total = 0
+        decode_ticks = occupancy_sum = 0
+        t_prefill = t_decode = 0.0
+
+        def retire(req, idx, now):
+            mgr.release(idx)
+            for d in (stream, committed, plans, pendtok):
+                d.pop(idx, None)
+            arr = sched.arrival_wall.get(req.uid, now)
+            e2e_s[req.uid] = now - arr
+            h_e2e.observe(now - arr)
+            n_tok = len(sched.finished[req.uid])
+            fw = first_wall.pop(req.uid, None)
+            if fw is not None and n_tok > 1:
+                h_itl.observe((now - fw) / (n_tok - 1))
+
+        def emit(idx, token, now):
+            """Record one generated token; True when the slot retired
+            (EOS or budget — same truncation rules as v1/generate)."""
+            done = sched.record(idx, token, self.eos_id)
+            if done is not None:
+                retire(done, idx, now)
+                return True
+            return False
+
+        def run_chunk(idx, ev):
+            nonlocal chunk_calls, t_prefill
+            req = sched.slots[idx].request
+            plan = plans[idx].pop(0)
+            L = len(req.prompt)
+            toks = chunk_tokens(stream[idx], plan, self.chunk,
+                                self.pad_fill)
+            self._make_writable(idx, committed[idx], plan.commit_to - 1)
+            wb, wo, _ = write_targets(plan.feed_start, self.chunk,
+                                      committed[idx], L,
+                                      mgr.tables[idx], bs)
+            table_dev = jnp.asarray(mgr.tables[idx])
+            toks_dev = jnp.asarray(toks, jnp.int32)
+            wb_dev, wo_dev = jnp.asarray(wb), jnp.asarray(wo)
+            pos = np.int32(plan.feed_start)
+            t0 = time.perf_counter()
+            self.pools, tok = self._chunk_prog(
+                self.params, self.pools, toks_dev, table_dev, pos,
+                np.int32(max(plan.logit_index, 0)), wb_dev, wo_dev,
+                self._next_key())
+            if self.draft_layers is not None:
+                self.draft_pools = self._draft_chunk(
+                    self.draft_params, self.draft_pools, toks_dev,
+                    table_dev, pos, wb_dev, wo_dev)
+            committed[idx] = plan.commit_to
+            mgr.register_committed(idx, stream[idx], committed[idx])
+            chunk_calls += 1
+            if ev is not None:
+                ev["chunks"].append(req.uid)
+            sched.note_chunk(idx)
+            if plan.is_last:
+                first = int(tok)       # host fetch = device barrier
+                now = time.perf_counter()
+                t_prefill += now - t0
+                pendtok[idx] = first
+                first_wall[req.uid] = now
+                ttft_s[req.uid] = now - sched.arrival_wall.get(req.uid,
+                                                               now)
+                h_ttft.observe(ttft_s[req.uid])
+                stream[idx].append(first)
+                emit(idx, first, now)
+            else:
+                jax.block_until_ready(self.pools)
+                t_prefill += time.perf_counter() - t0
+
+        t_start = time.perf_counter()
+        tick = 0
+        while sched.pending or sched.occupancy:
+            sched.mark_arrivals(tick, time.perf_counter())
+            g_queue.set(sched.queue_depth(tick))
+            ev = ({"tick": tick, "placed": [], "chunks": [],
+                   "decoded": []} if keep_timeline else None)
+
+            # admission: FIFO while a slot AND its whole block budget
+            # are available (no partial admission, no pool deadlock)
+            while sched.occupancy < self.max_slots:
+                head = sched.peek(tick)
+                if head is None:
+                    break
+                sp = mgr.match_prefix(head.prompt)
+                if not mgr.can_admit(sp, self._capacity_len(head)):
+                    break              # wait for retirements to free KV
+                idx, req = sched.place(tick)
+                shared = mgr.admit(idx, sp, self._capacity_len(req))
+                L = len(req.prompt)
+                stream[idx] = [int(t) for t in req.prompt]
+                committed[idx] = shared
+                plans[idx] = plan_chunks(shared, L, self.chunk)
+                sched.begin_prefill(idx, len(plans[idx]))
+                shared_tokens += shared
+                prompt_tokens += L
+                if ev is not None:
+                    ev["placed"].append(req.uid)
+
+            if not sched.occupancy:
+                nxt = sched.next_arrival()
+                if nxt is None:
+                    break
+                tick = max(tick, nxt)  # idle engine: jump to arrival
+                continue
+            occupancy_sum += sched.occupancy
+            g_occ.set(sched.occupancy)
+
+            # chunked prefill under the per-tick budget, round-robin
+            budget = self.chunks_per_tick
+            ran = 0
+            while budget > 0 and sched.prefilling:
+                for idx in sched.chunk_order():
+                    if budget == 0:
+                        break
+                    if idx not in sched.prefilling:
+                        continue       # finished earlier this pass
+                    run_chunk(idx, ev)
+                    budget -= 1
+                    ran += 1
+            h_chunks.observe(ran)
+
+            # decode every tick: live streams advance regardless of how
+            # much prefill work is queued — the stall bound
+            dec = sched.decoding_slots()
+            if dec:
+                if self.draft_layers is None:
+                    toks = np.zeros(self.max_slots, np.int32)
+                    pos = np.zeros(self.max_slots, np.int32)
+                    wb = np.full(self.max_slots, paged.TRASH, np.int32)
+                    wo = np.zeros(self.max_slots, np.int32)
+                    for i in dec:
+                        c = committed[i]
+                        self._make_writable(i, c, c)
+                        toks[i] = pendtok[i]
+                        pos[i] = c
+                        wb[i] = mgr.tables[i, c // bs]
+                        wo[i] = c % bs
+                    t0 = time.perf_counter()
+                    self.pools, out = self._decode(
+                        self.params, self.pools, jnp.asarray(mgr.tables),
+                        jnp.asarray(pos), jnp.asarray(toks),
+                        jnp.asarray(wb), jnp.asarray(wo),
+                        self._next_key())
+                    out = np.asarray(out)   # host fetch = device barrier
+                    now = time.perf_counter()
+                    t_decode += now - t0
+                    h_tick.observe(now - t0)
+                    decode_ticks += 1
+                    for i in dec:
+                        tok = int(out[i])
+                        committed[i] += 1
+                        stream[i].append(tok)
+                        mgr.register_committed(i, stream[i], committed[i])
+                        pendtok[i] = tok
+                        if ev is not None:
+                            ev["decoded"].append(
+                                sched.slots[i].request.uid)
+                        emit(i, tok, now)
+                else:
+                    k = self.spec_k
+                    T = k + 1
+                    toks = np.zeros(self.max_slots, np.int32)
+                    pos = np.zeros(self.max_slots, np.int32)
+                    wb = np.full((self.max_slots, T), paged.TRASH,
+                                 np.int32)
+                    wo = np.zeros((self.max_slots, T), np.int32)
+                    for i in dec:
+                        c = committed[i]
+                        self._make_writable(i, c, c + k)
+                        toks[i] = pendtok[i]
+                        pos[i] = c
+                        pp = np.arange(c, c + T)
+                        wb[i] = mgr.tables[i][pp // bs]
+                        wo[i] = pp % bs
+                    tables_dev = jnp.asarray(mgr.tables)
+                    pos_dev = jnp.asarray(pos)
+                    wb_dev, wo_dev = jnp.asarray(wb), jnp.asarray(wo)
+                    t0 = time.perf_counter()
+                    self.draft_pools, props = self._draft(
+                        self.draft_params, self.draft_pools, tables_dev,
+                        pos_dev, jnp.asarray(toks), wb_dev, wo_dev)
+                    props = np.asarray(props)
+                    verify_toks = np.concatenate(
+                        [toks[:, None], props], axis=1).astype(np.int32)
+                    self.pools, g = self._verify(
+                        self.params, self.pools, tables_dev, pos_dev,
+                        jnp.asarray(verify_toks), wb_dev, wo_dev)
+                    g = np.asarray(g)       # host fetch = device barrier
+                    now = time.perf_counter()
+                    t_decode += now - t0
+                    h_tick.observe(now - t0)
+                    decode_ticks += 1
+                    spec_rounds += len(dec)
+                    for i in dec:
+                        a, emitted = spec_mod.greedy_accept(props[i],
+                                                            g[i])
+                        proposed_total += k
+                        accepted_total += a
+                        h_accept.observe(a / k if k else 0.0)
+                        committed[i] += a + 1
+                        if ev is not None:
+                            ev["decoded"].append(
+                                sched.slots[i].request.uid)
+                        retired = False
+                        for tok in emitted:
+                            stream[i].append(tok)
+                            if emit(i, tok, now):
+                                retired = True
+                                break
+                        if not retired:
+                            pendtok[i] = emitted[-1]
+                            mgr.register_committed(i, stream[i],
+                                                   committed[i])
+            if ev is not None:
+                timeline.append(ev)
+            tick += 1
+
+        total = time.perf_counter() - t_start
+        tokens = int(sum(len(v) for v in sched.finished.values()))
+        hit = shared_tokens / prompt_tokens if prompt_tokens else 0.0
+        g_blocks.set(mgr.in_use)
+        g_hit.set(hit)
+        latency = {
+            "ttft_p50_s": h_ttft.percentile(50),
+            "ttft_p99_s": h_ttft.percentile(99),
+            "ttft_mean_s": h_ttft.mean,
+            "itl_p50_s": h_itl.percentile(50),
+            "itl_p99_s": h_itl.percentile(99),
+            "e2e_p50_s": h_e2e.percentile(50),
+            "e2e_p99_s": h_e2e.percentile(99),
+            "e2e_max_s": h_e2e.max if h_e2e.count else None,
+            "measured_requests": h_e2e.count,
+        }
+        spec_stats = {
+            "enabled": self.draft_layers is not None,
+            "k": self.spec_k if self.draft_layers is not None else 0,
+            "draft_layers": self.draft_layers,
+            "rounds": spec_rounds,
+            "proposed": proposed_total,
+            "accepted": accepted_total,
+            "acceptance_rate": (accepted_total / proposed_total)
+            if proposed_total else None,
+        }
+        stats = {
+            "engine": "paged",
+            "requests": n_req,
+            "rejected": len(errors),
+            "generated_tokens": tokens,
+            "tokens_per_sec": tokens / total if total else None,
+            "total_seconds": total,
+            "prefill_seconds": t_prefill,
+            "decode_seconds": t_decode,
+            "prefill_chunks": chunk_calls,
+            "decode_ticks": decode_ticks,
+            "mean_slot_occupancy":
+                occupancy_sum / decode_ticks if decode_ticks else 0.0,
+            "max_slots": self.max_slots,
+            "kv_block_size": bs,
+            "prefill_chunk": self.chunk,
+            "chunk_compiles": self._chunk_prog.traces,
+            "decode_compiles": self._decode.traces,
+            "copy_compiles": self._copy.traces,
+            "verify_compiles": self._verify.traces
+            if self.draft_layers is not None else 0,
+            "draft_compiles": self._draft.traces
+            if self.draft_layers is not None else 0,
+            "paged": {
+                **mgr.stats(),
+                "prefix_hit_rate": hit,
+                "shared_tokens": shared_tokens,
+                "prompt_tokens": prompt_tokens,
+                "prefill_tokens_computed": chunk_calls * self.chunk,
+            },
+            "spec": spec_stats,
+            "slo": slo_report(accepted, ttft_s, e2e_s),
+            "latency": latency,
+        }
+        if telemetry is not None:
+            telemetry.writer.emit("obs_serve", stats=stats)
+        out = {"results": sched.finished, "errors": errors, "stats": stats}
+        if keep_timeline:
+            out["timeline"] = timeline
+        return out

@@ -34,6 +34,10 @@ class Request:
     prompt: np.ndarray
     max_new_tokens: int
     arrival_tick: int = 0
+    # per-request service-level objectives, milliseconds; None = no SLO.
+    # The engine measures, serve/load.py:slo_report scores attainment.
+    slo_ttft_ms: Optional[float] = None
+    slo_e2e_ms: Optional[float] = None
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt)
@@ -44,6 +48,11 @@ class Request:
         if self.max_new_tokens < 1:
             raise ValueError(f"request {self.uid}: max_new_tokens must "
                              f"be >= 1, got {self.max_new_tokens}")
+        for name in ("slo_ttft_ms", "slo_e2e_ms"):
+            v = getattr(self, name)
+            if v is not None and v <= 0:
+                raise ValueError(f"request {self.uid}: {name} must be "
+                                 f"positive, got {v}")
 
 
 @dataclasses.dataclass
@@ -155,3 +164,59 @@ class SlotScheduler:
             if slot.active and slot.generated:
                 out[i] = slot.generated[-1]
         return out
+
+
+class PagedScheduler(SlotScheduler):
+    """:class:`SlotScheduler` plus a PREFILL stage with chunk fairness.
+
+    Under chunked prefill a placed request is not immediately decodable:
+    its prompt lands chunk by chunk across ticks.  This scheduler tracks
+    which slots are mid-prefill, and deals chunk turns ROUND-ROBIN
+    (rotating one step per tick) so a burst of long prompts splits the
+    per-tick chunk budget instead of the first one monopolising it.
+    Combined with the engine running decode every tick, both bounds
+    hold: in-flight streams stall at most one chunk budget per token,
+    and every queued prompt's prefill advances within a bounded number
+    of ticks of placement — the property the fairness regression test
+    pins down.
+    """
+
+    def __init__(self, max_slots: int):
+        super().__init__(max_slots)
+        self.prefilling: dict[int, int] = {}   # slot -> chunks remaining
+        self._turn = 0
+
+    def peek(self, tick: int) -> Optional[Request]:
+        """The request :meth:`place` would pop next, if one has arrived
+        — lets the engine test block-pool admission BEFORE committing a
+        slot to it (admission reserves a request's whole KV budget up
+        front, which is what makes the pool deadlock-free)."""
+        if self._queue and self._queue[0].arrival_tick <= tick:
+            return self._queue[0]
+        return None
+
+    def begin_prefill(self, idx: int, n_chunks: int) -> None:
+        if n_chunks < 1:
+            raise ValueError(f"slot {idx}: prefill needs >= 1 chunk")
+        self.prefilling[idx] = n_chunks
+
+    def note_chunk(self, idx: int) -> bool:
+        """One chunk landed; True when the slot's prefill completed and
+        it joins the decodable set."""
+        self.prefilling[idx] -= 1
+        if self.prefilling[idx] <= 0:
+            del self.prefilling[idx]
+            return True
+        return False
+
+    def decoding_slots(self) -> list:
+        return [i for i in self.active_slots if i not in self.prefilling]
+
+    def chunk_order(self) -> list:
+        """Slots still prefilling, rotated one position per call so no
+        slot owns the front of the budget two ticks running."""
+        ids = sorted(self.prefilling)
+        if not ids:
+            return []
+        self._turn = (self._turn + 1) % len(ids)
+        return ids[self._turn:] + ids[:self._turn]

@@ -175,6 +175,16 @@ class Config:
     max_slots: int = 8                  # serving: concurrent decode slots
     prefill_buckets: tuple[int, ...] | None = None  # serving: prefill pad
                                         #   lengths (None = powers of two)
+    paged: bool = False                 # serving: paged-KV engine with
+                                        #   prefix reuse + chunked prefill
+                                        #   (serve/paged.py, PagedEngine)
+    kv_block_size: int = 16             # serving: paged-KV block tokens
+    prefill_chunk: int = 32             # serving: chunked-prefill width
+    draft: int = 0                      # serving: truncated-draft layers
+                                        #   for speculative decoding (0=off)
+    spec_k: int = 4                     # serving: draft tokens per round
+    slo_ttft_ms: float | None = None    # serving: per-request TTFT SLO
+    slo_e2e_ms: float | None = None     # serving: per-request e2e SLO
     pos_embedding: str = "learned"      # learned | rope (gpt)
     num_kv_heads: int | None = None     # grouped-query attention (gpt)
     label_smoothing: float = 0.0        # token-CE smoothing (LM families)
@@ -387,6 +397,38 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
                    help="serving: comma-separated prompt-padding bucket "
                         "lengths — one compiled prefill program each "
                         "(default: powers of two up to the cache length)")
+    p.add_argument("--paged", action="store_true",
+                   help="serving: use the paged-KV engine — block pools "
+                        "with rolling-hash prefix reuse (shared prompt "
+                        "prefixes prefill once), chunked prefill "
+                        "interleaved with decode, optional speculative "
+                        "decoding via --draft")
+    p.add_argument("--kv-block-size", dest="kv_block_size", type=int,
+                   default=16, metavar="B",
+                   help="paged serving: tokens per KV block (prefix "
+                        "sharing granularity; smaller = more sharing, "
+                        "more gather work)")
+    p.add_argument("--prefill-chunk", dest="prefill_chunk", type=int,
+                   default=32, metavar="C",
+                   help="paged serving: prefill slice width — in-flight "
+                        "decode streams stall at most ~one chunk of "
+                        "compute per token, whatever the prompt length")
+    p.add_argument("--draft", type=int, default=0, metavar="N",
+                   help="paged serving: speculative decoding with a "
+                        "draft built from the target's first N layers "
+                        "(shared weights; greedy outputs stay "
+                        "bit-identical); 0 disables")
+    p.add_argument("--spec-k", dest="spec_k", type=int, default=4,
+                   metavar="K",
+                   help="paged serving: draft tokens proposed per round "
+                        "(verified in one batched target forward)")
+    p.add_argument("--slo-ttft-ms", dest="slo_ttft_ms", type=float,
+                   default=None, metavar="MS",
+                   help="serving: per-request time-to-first-token SLO; "
+                        "attainment is reported in the serve stats")
+    p.add_argument("--slo-e2e-ms", dest="slo_e2e_ms", type=float,
+                   default=None, metavar="MS",
+                   help="serving: per-request end-to-end latency SLO")
     p.add_argument("--schedule", dest="lr_schedule",
                    choices=["none", "cosine", "rsqrt", "step"],
                    default="none",
@@ -475,6 +517,10 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
 
 
 def parse_buckets_arg(text: str | None) -> tuple[int, ...] | None:
+    """``--prefill-buckets`` string → ascending lengths, validated at
+    parse time (mirrors :func:`parse_mesh_arg`: a bad flag is an
+    argparse-style error at the CLI boundary, not a traceback from the
+    engine mid-run)."""
     if not text:
         return None
     try:
@@ -485,6 +531,15 @@ def parse_buckets_arg(text: str | None) -> tuple[int, ...] | None:
     if any(b < 1 for b in buckets):
         raise SystemExit(f"--prefill-buckets {text!r}: lengths must be "
                          ">= 1")
+    for a, b in zip(buckets, buckets[1:]):
+        if b == a:
+            raise SystemExit(f"--prefill-buckets {text!r}: duplicate "
+                             f"bucket {a} (each bucket is one compiled "
+                             "prefill program; listing it twice is "
+                             "always a mistake)")
+        if b < a:
+            raise SystemExit(f"--prefill-buckets {text!r}: lengths must "
+                             f"be strictly ascending, got {b} after {a}")
     return buckets
 
 
@@ -575,6 +630,27 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
     if args.obs_file and not args.obs:
         raise SystemExit("--obs-file requires --obs (the path names the "
                          "telemetry stream --obs records)")
+    if args.max_slots <= 0:
+        raise SystemExit(f"--max-slots {args.max_slots}: must be >= 1 "
+                         "(the engine's static batch dimension)")
+    if args.kv_block_size < 1:
+        raise SystemExit(f"--kv-block-size {args.kv_block_size}: must "
+                         "be >= 1")
+    if args.prefill_chunk < 1:
+        raise SystemExit(f"--prefill-chunk {args.prefill_chunk}: must "
+                         "be >= 1")
+    if args.draft < 0:
+        raise SystemExit(f"--draft {args.draft}: must be >= 0 (0 turns "
+                         "speculative decoding off)")
+    if args.draft and not args.paged:
+        raise SystemExit("--draft requires --paged (speculation runs "
+                         "inside the paged engine)")
+    if args.spec_k < 1:
+        raise SystemExit(f"--spec-k {args.spec_k}: must be >= 1")
+    for flag, v in (("--slo-ttft-ms", args.slo_ttft_ms),
+                    ("--slo-e2e-ms", args.slo_e2e_ms)):
+        if v is not None and v <= 0:
+            raise SystemExit(f"{flag} {v}: must be positive milliseconds")
     return Config(
         num_layers=args.nlayers,
         size=args.size,
@@ -613,6 +689,13 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         serve=args.serve,
         max_slots=args.max_slots,
         prefill_buckets=parse_buckets_arg(args.prefill_buckets),
+        paged=args.paged,
+        kv_block_size=args.kv_block_size,
+        prefill_chunk=args.prefill_chunk,
+        draft=args.draft,
+        spec_k=args.spec_k,
+        slo_ttft_ms=args.slo_ttft_ms,
+        slo_e2e_ms=args.slo_e2e_ms,
         pos_embedding=args.pos_embedding,
         num_kv_heads=args.num_kv_heads,
         label_smoothing=args.label_smoothing,

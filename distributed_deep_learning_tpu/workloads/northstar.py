@@ -539,7 +539,9 @@ def _gpt_serve(config: Config, state, logger, dataset) -> None:
     engine (serve/engine.py) on the just-trained weights and log
     tokens/sec, mean slot occupancy and compile counts — the
     serving-path sibling of ``--generate``'s batch-synchronous smoke
-    sample."""
+    sample.  With ``--paged`` the trace goes through the paged engine
+    instead (block KV + prefix reuse + chunked prefill, ``--draft N``
+    speculation) and the log line adds hit rate / acceptance / SLOs."""
     from distributed_deep_learning_tpu.serve.bench import (make_trace,
                                                            run_engine)
 
@@ -557,6 +559,10 @@ def _gpt_serve(config: Config, state, logger, dataset) -> None:
     p_hi = max(2, min(_GENERATE_PROMPT_LEN, seq, model.max_len - 1))
     new_hi = max(1, min(config.generate_tokens or 16,
                         model.max_len - p_hi))
+    if config.paged:
+        _gpt_serve_paged(config, model, params, logger, dataset,
+                         p_hi, new_hi)
+        return
     trace = make_trace(max(2 * config.max_slots, 8),
                        vocab_size=_vocab(dataset), seed=config.seed,
                        prompt_lens=(2, p_hi), new_tokens=(1, new_hi))
@@ -568,6 +574,58 @@ def _gpt_serve(config: Config, state, logger, dataset) -> None:
         f"at {s['tokens_per_sec']:.1f} tok/s, occupancy "
         f"{s['mean_slot_occupancy']:.2f}/{s['max_slots']}, compiles "
         f"prefill={s['prefill_compiles']} decode={s['decode_compiles']}")
+
+
+def _gpt_serve_paged(config: Config, model, params, logger, dataset,
+                     p_hi: int, new_hi: int) -> None:
+    """``--serve --paged``: the same trace shape through the paged
+    engine, with the config's block/chunk/draft/SLO knobs applied."""
+    import dataclasses
+
+    from distributed_deep_learning_tpu.serve.bench import (make_trace,
+                                                           paged_max_len,
+                                                           run_paged)
+
+    draft = config.draft or None
+    if draft is not None and not 1 <= draft < model.num_layers:
+        logger.info(f"serve: --draft {draft} needs 1 <= draft < "
+                    f"{model.num_layers} (the model's layer count); "
+                    "speculation disabled")
+        draft = None
+    block = min(config.kv_block_size, model.max_len)
+    try:
+        cap = paged_max_len(model.max_len, block, draft is not None,
+                            config.spec_k)
+    except ValueError as exc:
+        logger.info(f"serve: paged engine skipped ({exc})")
+        return
+    p_hi = max(2, min(p_hi, cap - 1))
+    new_hi = max(1, min(new_hi, cap - p_hi))
+    trace = make_trace(max(2 * config.max_slots, 8),
+                       vocab_size=_vocab(dataset), seed=config.seed,
+                       prompt_lens=(2, p_hi), new_tokens=(1, new_hi))
+    if config.slo_ttft_ms or config.slo_e2e_ms:
+        trace = [dataclasses.replace(r, slo_ttft_ms=config.slo_ttft_ms,
+                                     slo_e2e_ms=config.slo_e2e_ms)
+                 for r in trace]
+    out = run_paged(model, params, trace, max_slots=config.max_slots,
+                    max_len=cap, kv_block_size=block,
+                    prefill_chunk=min(config.prefill_chunk, cap),
+                    draft_layers=draft, spec_k=config.spec_k)
+    s = out["stats"]
+    pg, sp, slo = s["paged"], s["spec"], s["slo"]
+    line = (f"serve(paged): {s['requests']} requests, "
+            f"{s['generated_tokens']} tokens at "
+            f"{s['tokens_per_sec']:.1f} tok/s, prefix hit "
+            f"{pg['prefix_hit_rate']:.3f}, cow {pg['cow_copies']}, "
+            f"compiles chunk={s['chunk_compiles']} "
+            f"decode={s['decode_compiles']} "
+            f"verify={s['verify_compiles']}")
+    if sp["enabled"] and sp["acceptance_rate"] is not None:
+        line += f", spec acceptance {sp['acceptance_rate']:.3f}"
+    if slo["slo_attainment"] is not None:
+        line += f", slo attainment {slo['slo_attainment']:.2f}"
+    logger.info(line)
 
 
 def _gpt_post(config: Config, state, logger, dataset) -> None:

@@ -349,6 +349,43 @@ def serving(n_requests=48, max_slots=16):
     return {"section": "serving", "on_tpu": on_tpu, **rec}
 
 
+def serving_paged(n_requests=48, max_slots=16):
+    """Paged engine under trace-driven SLO load at a TPU-shaped geometry
+    (ISSUE 9): shared-system-prompt Poisson trace, chunked prefill,
+    1-layer speculative draft, A/B'd against the v1 engine on the same
+    trace.  On TPU the interesting harvest is whether prefix reuse and
+    speculation still pay once the per-token device time shrinks — the
+    host-side block bookkeeping is a fixed cost per tick, so this section
+    decides how much of the paged win is compute saved vs host overhead
+    moved."""
+    import jax
+
+    from distributed_deep_learning_tpu.serve.bench import paged_serving_bench
+
+    on_tpu = jax.default_backend() == "tpu"
+    model_kw = (dict(vocab_size=32768, num_layers=12, d_model=768,
+                     num_heads=12, mlp_dim=3072, max_len=1024)
+                if on_tpu else
+                dict(vocab_size=512, num_layers=2, d_model=128,
+                     num_heads=4, mlp_dim=256, max_len=192))
+    load_kw = (dict(n_requests=n_requests, arrival="poisson", rate=4.0,
+                    prompt_short=(16, 64), prompt_long=(128, 384),
+                    long_frac=0.3, shared_prefix_len=128, shared_frac=0.6,
+                    new_tokens=(16, 128), slo_ttft_ms=500.0,
+                    slo_e2e_ms=5000.0)
+               if on_tpu else
+               dict(n_requests=10))
+    rec = paged_serving_bench(
+        load_kw=load_kw,
+        model_kw=model_kw,
+        max_slots=max_slots if on_tpu else 4,
+        kv_block_size=32 if on_tpu else 16,
+        prefill_chunk=128 if on_tpu else 32,
+        draft_layers=2 if on_tpu else 1,
+        spec_k=4)
+    return {"section": "serving_paged", "on_tpu": on_tpu, **rec}
+
+
 def autotune(workload="gpt"):
     """Auto-parallelism planner on real hardware: search the plan lattice
     for a TPU-shaped LM geometry (small-GPT on TPU, toy on CPU smoke) and
@@ -470,8 +507,8 @@ def _record_flash_gate(result: dict) -> None:
 
 SECTIONS = ("flash_block_sweep", "flash_vs_dense", "gqa_speedup",
             "s2d_vs_plain", "batch_sweep", "lm_tokens", "serving",
-            "autotune", "reshard", "observability", "mfu_diag",
-            "lm_sweep")
+            "serving_paged", "autotune", "reshard", "observability",
+            "mfu_diag", "lm_sweep")
 
 
 def _run_section(name: str) -> None:

@@ -1,0 +1,449 @@
+"""Paged serving engine (ISSUE 9): paged KV + prefix reuse + chunked
+prefill + speculative decoding, under the same two load-bearing
+guarantees as the v1 engine — compile-once and bit-identical greedy
+outputs against ``generate()`` — plus the new ones this generation
+adds:
+
+* prefix reuse measurably reduces prefill compute WITHOUT changing one
+  output token (shared blocks are referenced, the last prompt token is
+  always recomputed, copy-on-write isolates divergence);
+* chunked prefill bounds decode stalls: live streams decode EVERY tick
+  while a long prompt lands chunk by chunk (timeline-asserted);
+* speculative decoding preserves exact greedy parity while the target
+  runs fewer forwards (verify replaces plain decode: ``decode==0``,
+  ``verify==1``, ``draft==1`` compile counts);
+* a burst of long prompts cannot starve a queued short request
+  (round-robin chunk budget → bounded wait — the fairness regression).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_deep_learning_tpu.models.transformer import (CausalLM,
+                                                              generate)
+from distributed_deep_learning_tpu.serve.engine import PagedEngine
+from distributed_deep_learning_tpu.serve.load import (LoadSpec, make_load,
+                                                      slo_report)
+from distributed_deep_learning_tpu.serve.paged import (TRASH, BlockManager,
+                                                       chain_hash)
+from distributed_deep_learning_tpu.serve.prefill import (plan_chunks,
+                                                         write_targets)
+from distributed_deep_learning_tpu.serve.scheduler import Request
+from distributed_deep_learning_tpu.serve.spec import (greedy_accept,
+                                                      truncated_draft)
+from distributed_deep_learning_tpu.utils.config import parse_args
+
+MODEL = dict(vocab_size=61, num_layers=2, d_model=32, num_heads=4,
+             mlp_dim=64, max_len=48)
+
+
+@functools.lru_cache(maxsize=None)
+def _shared(**kw):
+    model = CausalLM(**{**MODEL, **kw})
+    toks = jnp.ones((1, 4), jnp.int32)
+    return model, model.init(jax.random.key(1), toks)["params"]
+
+
+def _engine(**kw):
+    model, params = _shared()
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("kv_block_size", 8)
+    kw.setdefault("prefill_chunk", 8)
+    return PagedEngine(model, params, **kw)
+
+
+def _trace(seed=0, n=6, max_new=(1, 10), plens=(3, 20), stagger=3):
+    rng = np.random.default_rng(seed)
+    reqs, tick = [], 0
+    for uid in range(n):
+        p = int(rng.integers(*plens))
+        reqs.append(Request(uid, rng.integers(1, 61, p).astype(np.int32),
+                            int(rng.integers(*max_new)),
+                            arrival_tick=tick))
+        tick += int(rng.integers(0, stagger + 1))
+    return reqs
+
+
+def _check_parity(out, reqs, label="", **model_kw):
+    model, params = _shared(**model_kw)
+    for r in reqs:
+        ref = generate(model, params, jnp.asarray(r.prompt)[None],
+                       max_new_tokens=r.max_new_tokens)
+        np.testing.assert_array_equal(out["results"][r.uid],
+                                      np.asarray(ref)[0],
+                                      err_msg=f"{label} request {r.uid}")
+
+
+# --- the tentpole guarantees -------------------------------------------
+
+
+def test_paged_matches_generate_and_compiles_once():
+    """Bit-identical greedy outputs vs generate() across a mixed trace,
+    with EXACTLY one chunk-prefill, one decode, and (at most) one
+    block-copy compilation for the engine's lifetime — across TWO
+    run() calls (the second starts with a warm prefix index)."""
+    eng = _engine()
+    reqs = _trace(n=5, max_new=(1, 8), plens=(3, 16))
+    out = eng.run(reqs)
+    assert not out["errors"]
+    _check_parity(out, reqs, label="run1")
+    s = out["stats"]
+    assert s["chunk_compiles"] == 1, s
+    assert s["decode_compiles"] == 1, s
+    assert s["verify_compiles"] == 0, s
+
+    reqs2 = _trace(seed=9, n=3)
+    out2 = eng.run(reqs2)
+    _check_parity(out2, reqs2, label="run2")
+    s2 = out2["stats"]
+    assert s2["chunk_compiles"] == 1 and s2["decode_compiles"] == 1, s2
+
+
+def test_prefix_reuse_skips_prefill_same_tokens_out():
+    """Requests opening with one shared system prompt: the paged engine
+    prefills the shared blocks ONCE, later requests reference them
+    (hit rate > 0, fewer prefill tokens computed) — and every output
+    token still matches generate() exactly."""
+    rng = np.random.default_rng(5)
+    sys_prompt = rng.integers(1, 61, 17).astype(np.int32)
+    reqs = []
+    for uid in range(4):
+        tail = rng.integers(1, 61, 4 + uid).astype(np.int32)
+        reqs.append(Request(uid, np.concatenate([sys_prompt, tail]),
+                            6, arrival_tick=0))
+    eng = _engine(max_slots=2)
+    out = eng.run(reqs)
+    assert not out["errors"]
+    _check_parity(out, reqs, label="shared-prefix")
+    pg = out["stats"]["paged"]
+    # requests 0-1 are admitted together into an empty index; 2-3 admit
+    # after blocks committed and reuse the two full 8-blocks each (the
+    # partial 3rd block may add more via the children index)
+    assert pg["shared_tokens"] >= 2 * 16, pg
+    assert pg["prefix_hit_rate"] > 0.3, pg
+    assert pg["prefill_tokens_computed"] < pg["prompt_tokens"] + \
+        8 * len(reqs), pg
+
+    # a SECOND trace with the same system prompt through the same
+    # engine starts with a warm index: the shared prefix is never
+    # recomputed
+    tail = rng.integers(1, 61, 5).astype(np.int32)
+    reqs2 = [Request(10, np.concatenate([sys_prompt, tail]), 4,
+                     arrival_tick=0)]
+    out2 = eng.run(reqs2)
+    _check_parity(out2, reqs2, label="warm-index")
+    assert out2["stats"]["paged"]["shared_tokens"] >= 16
+
+
+def test_copy_on_write_isolates_divergence():
+    """Two prompts sharing a PARTIAL block (12 tokens, block size 8):
+    the second matches mid-block, gets a copy-on-write reserve block,
+    and neither request's outputs are perturbed by the other."""
+    rng = np.random.default_rng(7)
+    shared = rng.integers(1, 61, 12).astype(np.int32)
+    a = Request(0, np.concatenate([shared,
+                                   rng.integers(1, 61, 6).astype(np.int32)]),
+                5, arrival_tick=0)
+    # B arrives once A has committed (and registered) both blocks the
+    # 12-token prefix spans — the partial match on block 1 is what
+    # forces the copy
+    b = Request(1, np.concatenate([shared,
+                                   rng.integers(1, 61, 9).astype(np.int32)]),
+                5, arrival_tick=4)
+    eng = _engine(max_slots=2)
+    out = eng.run([a, b])
+    assert not out["errors"]
+    _check_parity(out, [a, b], label="cow")
+    assert out["stats"]["paged"]["cow_copies"] >= 1, out["stats"]["paged"]
+
+
+def test_spec_decoding_exact_parity_fewer_target_forwards():
+    """Speculative decoding with a truncated 1-layer draft: outputs are
+    bit-identical to generate() (greedy parity is exact, acceptance only
+    changes speed), the verify and draft programs compile once each, and
+    plain decode never runs (``decode_compiles == 0``)."""
+    reqs = _trace(seed=3, n=4, max_new=(4, 10), plens=(3, 14))
+    eng = _engine(max_len=40, draft_layers=1, spec_k=3)
+    out = eng.run(reqs)
+    assert not out["errors"]
+    _check_parity(out, reqs, label="spec")
+    s = out["stats"]
+    assert s["decode_compiles"] == 0, s
+    assert s["verify_compiles"] == 1, s
+    assert s["draft_compiles"] == 1, s
+    assert s["chunk_compiles"] == 1, s
+    sp = s["spec"]
+    assert sp["enabled"] and sp["rounds"] > 0
+    assert sp["acceptance_rate"] is not None
+    assert 0.0 <= sp["acceptance_rate"] <= 1.0
+    # every accepted proposal is one target forward the engine skipped
+    assert sp["proposed"] == sp["rounds"] * 3
+
+
+def test_chunked_prefill_bounds_decode_stalls():
+    """The stall bound, tick by tick: while a 40-token prompt lands in
+    8-token chunks, the already-live short request decodes EVERY tick —
+    a long arrival costs live streams at most one chunk of compute per
+    tick, never a whole prompt."""
+    rng = np.random.default_rng(11)
+    short = Request(0, rng.integers(1, 61, 4).astype(np.int32), 20,
+                    arrival_tick=0)
+    long_ = Request(1, rng.integers(1, 61, 40).astype(np.int32), 3,
+                    arrival_tick=2)
+    eng = _engine(max_slots=2, prefill_chunk=8)
+    out = eng.run([short, long_], keep_timeline=True)
+    assert not out["errors"]
+    _check_parity(out, [short, long_], label="stall")
+    tl = out["timeline"]
+    # the long prompt takes ceil(40/8) = 5 chunk ticks
+    chunk_ticks = [ev["tick"] for ev in tl if 1 in ev["chunks"]]
+    assert len(chunk_ticks) == 5, tl
+    # budget: at most chunks_per_tick (=1) chunks ever run in one tick
+    assert all(len(ev["chunks"]) <= 1 for ev in tl)
+    # THE bound: on every tick the long prompt was prefilling, the
+    # short request still decoded
+    short_decode_ticks = {ev["tick"] for ev in tl if 0 in ev["decoded"]}
+    for t in chunk_ticks:
+        assert t in short_decode_ticks, \
+            f"tick {t}: short stalled behind long prefill\n{tl}"
+
+
+def test_burst_of_long_prompts_cannot_starve_short():
+    """Fairness regression: three 40-token prompts and one short
+    request all admitted at tick 0.  The round-robin chunk budget
+    guarantees the short request's single chunk runs within
+    ``max_slots`` ticks and it decodes every tick after — a long-prompt
+    burst delays it by a bounded number of chunks, not by the burst's
+    total prefill work."""
+    rng = np.random.default_rng(13)
+    reqs = [Request(u, rng.integers(1, 61, 40).astype(np.int32), 2,
+                    arrival_tick=0) for u in range(3)]
+    reqs.append(Request(3, rng.integers(1, 61, 5).astype(np.int32), 8,
+                        arrival_tick=0))
+    eng = _engine(max_slots=4, prefill_chunk=8)
+    out = eng.run(reqs, keep_timeline=True)
+    assert not out["errors"]
+    tl = out["timeline"]
+    first_chunk = next(ev["tick"] for ev in tl if 3 in ev["chunks"])
+    assert first_chunk < 4, \
+        f"short request's prefill waited {first_chunk} ticks\n{tl}"
+    # once live it decodes on EVERY subsequent tick until retirement,
+    # long burst or not
+    decoded = [ev["tick"] for ev in tl if 3 in ev["decoded"]]
+    assert len(decoded) >= 1
+    assert decoded == list(range(decoded[0], decoded[0] + len(decoded))), \
+        f"short request skipped decode ticks: {decoded}"
+    _check_parity(out, reqs, label="fairness")
+
+
+@pytest.mark.slow
+def test_admission_waits_for_blocks_never_deadlocks():
+    """A trace larger than the block pool: admission reserves each
+    request's WHOLE budget or waits, so the pool can never deadlock
+    mid-request — everything completes, with evictions or head-of-line
+    waits, and outputs stay exact."""
+    reqs = _trace(seed=17, n=6, plens=(10, 18), max_new=(4, 8))
+    # 2 slots x 6 blocks, +2 spare: admission must throttle
+    eng = _engine(max_slots=2, num_blocks=14)
+    out = eng.run(reqs)
+    assert not out["errors"]
+    assert len(out["results"]) == len(reqs)
+    _check_parity(out, reqs, label="pressure")
+    pg = out["stats"]["paged"]
+    assert pg["blocks_peak_in_use"] <= 14
+
+
+def test_request_longer_than_capacity_rejected():
+    eng = _engine(max_slots=1)
+    big = Request(0, np.ones(45, np.int32), 10, arrival_tick=0)
+    out = eng.run([big])
+    assert 0 in out["errors"]
+    assert not out["results"]
+
+
+# --- unit layers --------------------------------------------------------
+
+
+def test_chain_hash_commits_to_whole_prefix():
+    h1 = chain_hash(b"", [1, 2, 3])
+    assert chain_hash(b"", [1, 2, 3]) == h1
+    assert chain_hash(b"", [1, 2, 4]) != h1
+    h2 = chain_hash(h1, [4, 5])
+    # same chunk under a different parent → different chain hash
+    assert chain_hash(chain_hash(b"", [9, 9, 9]), [4, 5]) != h2
+
+
+def test_block_manager_refcounts_and_eviction():
+    mgr = BlockManager(num_blocks=8, block_size=4, max_slots=2,
+                       blocks_per_slot=4)
+    prompt = list(range(1, 14))           # 13 tokens: 3 full blocks - 1
+    sp = mgr.match_prefix(prompt)
+    assert mgr.shared_len(sp) == 0        # cold index
+    shared = mgr.admit(0, sp, 16)
+    assert shared == 0 and mgr.in_use == 4
+    mgr.register_committed(0, prompt, 12)
+    mgr.release(0)
+    # registered blocks outlive the request (index holds the ref) ...
+    assert mgr.in_use == 3
+    # ... and a matching prompt reuses them, capped at L-1 so the last
+    # token is always recomputed for first-token sampling
+    sp2 = mgr.match_prefix(prompt)
+    assert mgr.shared_len(sp2) == 12      # 12 < 13 - 1 is false: 12 = L-1
+    sp3 = mgr.match_prefix(prompt[:13])
+    assert mgr.shared_len(sp3) <= len(prompt) - 1
+    # filling the pool evicts LRU index blocks rather than failing
+    shared2 = mgr.admit(0, sp2, 16)
+    assert shared2 == 12
+    mgr.release(0)
+    sp4 = mgr.match_prefix([50, 51, 52, 53, 54])
+    assert mgr.can_admit(sp4, 20) is False or mgr.in_use <= 8
+
+
+def test_plan_chunks_tail_shift_single_width():
+    # 19 unshared tokens in 8-token chunks: 0-8, 8-16, then the LAST
+    # chunk slides back to keep one static width (feed 11..19)
+    plans = plan_chunks(0, 19, 8)
+    assert [(p.feed_start, p.commit_to) for p in plans] == \
+        [(0, 8), (8, 16), (11, 19)]
+    assert [p.is_last for p in plans] == [False, False, True]
+    assert plans[-1].logit_index == 18 - 11
+    # shared prefix shifts the start; a short remainder is one chunk
+    plans = plan_chunks(12, 15, 8)
+    assert [(p.feed_start, p.commit_to) for p in plans] == [(7, 15)]
+    assert plans[0].logit_index == 14 - 7
+    with pytest.raises(ValueError):
+        plan_chunks(5, 5, 8)
+
+
+def test_write_targets_route_overlap_to_trash():
+    table = np.array([3, 7, 9, 2], np.int32)
+    blocks, offsets, live = write_targets(
+        feed_start=5, n=8, committed=8, length=11, table_row=table,
+        block_size=4)
+    # positions 5..7 are already committed, 11..12 beyond the prompt:
+    # both land in the trash block; 8..10 write for real
+    assert list(blocks[:3]) == [TRASH] * 3
+    assert list(blocks[3:6]) == [9, 9, 9]
+    assert list(offsets[3:6]) == [0, 1, 2]
+    assert list(blocks[6:]) == [TRASH] * 2
+    assert list(live) == [0, 0, 0, 1, 1, 1, 0, 0]
+
+
+def test_greedy_accept_prefix_semantics():
+    a, em = greedy_accept([5, 6, 7], [5, 6, 7, 8])
+    assert (a, em) == (3, [5, 6, 7, 8])       # all accepted + bonus
+    a, em = greedy_accept([5, 6, 7], [5, 9, 1, 2])
+    assert (a, em) == (1, [5, 9])             # correction replaces d_1
+    a, em = greedy_accept([5, 6, 7], [4, 1, 2, 3])
+    assert (a, em) == (0, [4])                # pure fallback to target
+    with pytest.raises(ValueError):
+        greedy_accept([5, 6], [5, 6])
+
+
+def test_truncated_draft_shares_weights():
+    model, params = _shared()
+    draft, dparams = truncated_draft(model.clone(decode=True), params, 1)
+    assert draft.num_layers == 1
+    assert dparams["embed"] is params["embed"]
+    assert "layer_1" not in dparams
+    with pytest.raises(ValueError):
+        truncated_draft(model.clone(decode=True), params, 2)
+
+
+# --- trace-driven load + SLOs ------------------------------------------
+
+
+def test_make_load_shapes_and_determinism():
+    spec = LoadSpec(n_requests=12, arrival="poisson", rate=1.5,
+                    shared_prefix_len=6, shared_frac=1.0,
+                    prompt_short=(2, 4), prompt_long=(8, 10),
+                    slo_ttft_ms=100.0)
+    a = make_load(spec, vocab_size=61, seed=4)
+    b = make_load(spec, vocab_size=61, seed=4)
+    assert [r.prompt.tolist() for r in a] == \
+        [r.prompt.tolist() for r in b]
+    head = a[0].prompt[:6].tolist()
+    assert all(r.prompt[:6].tolist() == head for r in a)  # one sys prompt
+    ticks = [r.arrival_tick for r in a]
+    assert ticks == sorted(ticks)
+    assert all(r.slo_ttft_ms == 100.0 for r in a)
+
+    bursty = make_load(LoadSpec(n_requests=8, arrival="bursty",
+                                burst_every=5, burst_size=4),
+                       vocab_size=61, seed=0)
+    assert sorted({r.arrival_tick for r in bursty}) == [0, 5]
+
+
+def test_slo_report_counts_misses():
+    reqs = [Request(0, np.ones(3, np.int32), 2, slo_ttft_ms=100.0),
+            Request(1, np.ones(3, np.int32), 2, slo_e2e_ms=1000.0),
+            Request(2, np.ones(3, np.int32), 2)]
+    rep = slo_report(reqs, {0: 0.05, 1: 5.0}, {0: 0.2, 1: 0.5})
+    assert rep["slo_checked"] == 2          # request 2 has no SLO
+    assert rep["slo_attained"] == 2         # 1's TTFT is unconstrained
+    rep = slo_report(reqs, {0: 0.25}, {0: 0.3, 1: 2.0})
+    assert rep["slo_ttft_misses"] == 1      # 0 blew 100ms
+    assert rep["slo_e2e_misses"] == 1       # 1 blew 1s
+    assert rep["slo_attainment"] == 0.0
+    # a request with an SLO but NO measurement is a miss, not a skip
+    rep = slo_report(reqs, {}, {})
+    assert rep["slo_checked"] == 2 and rep["slo_attained"] == 0
+    assert slo_report([reqs[2]], {}, {})["slo_attainment"] is None
+
+
+# --- CLI validation (satellite: parse-time, clear SystemExit) ----------
+
+
+@pytest.mark.parametrize("argv,msg", [
+    (["--max-slots", "0"], "--max-slots"),
+    (["--max-slots", "-2"], "--max-slots"),
+    (["--prefill-buckets", "8,8"], "duplicate"),
+    (["--prefill-buckets", "16,8"], "ascending"),
+    (["--draft", "1"], "--draft requires --paged"),
+    (["--paged", "--draft", "-1"], "--draft"),
+    (["--paged", "--slo-ttft-ms", "0"], "--slo-ttft-ms"),
+])
+def test_cli_rejects_bad_serving_flags(argv, msg):
+    base = ["-l", "1", "-s", "32", "-e", "1", "-b", "16"]
+    with pytest.raises(SystemExit, match=msg.replace("-", r"\-")):
+        parse_args(base + argv, workload="gpt")
+
+
+def test_cli_accepts_paged_flags():
+    cfg = parse_args(["-l", "2", "-s", "32", "-e", "1", "-b", "16",
+                      "--paged", "--kv-block-size", "8",
+                      "--prefill-chunk", "16", "--draft", "1",
+                      "--spec-k", "3", "--slo-ttft-ms", "500"],
+                     workload="gpt")
+    assert cfg.paged and cfg.kv_block_size == 8
+    assert cfg.prefill_chunk == 16 and cfg.draft == 1 and cfg.spec_k == 3
+    assert cfg.slo_ttft_ms == 500.0 and cfg.slo_e2e_ms is None
+
+
+# --- bench harness (one place defines the load shapes) -----------------
+
+
+def test_paged_serving_bench_record_fields():
+    from distributed_deep_learning_tpu.serve.bench import \
+        paged_serving_bench
+
+    rec = paged_serving_bench(
+        model_kw=MODEL, max_slots=2, kv_block_size=8, prefill_chunk=8,
+        load_kw=dict(n_requests=4, arrival="front", rate=None,
+                     prompt_short=(3, 6), prompt_long=(10, 16),
+                     shared_prefix_len=6, shared_frac=0.5,
+                     new_tokens=(2, 6), slo_ttft_ms=60000.0,
+                     slo_e2e_ms=60000.0),
+        compare_engine=False)
+    pe = rec["paged_engine"]
+    for key in ("prefix_hit_rate", "slo_attainment", "spec_acceptance",
+                "chunk_compiles", "decode_compiles", "latency"):
+        assert key in pe, key
+    assert pe["decode_compiles"] == 1
+    assert rec["errors"] == 0
+    assert pe["slo"]["slo_checked"] == 4
