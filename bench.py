@@ -578,6 +578,54 @@ def _observability() -> dict | None:
         repeats=int(os.environ.get("BENCH_OBS_REPEATS", 5)))
 
 
+def _collectives() -> dict | None:
+    """Quantized + ring-overlapped FSDP collectives (ISSUE 10): the
+    ``scripts/comm_bench.py`` record — analytic wire bytes per method
+    (the int8-vs-fp32 >= 3x gate), ring bit-parity and quantized
+    numerics, the fused ``gather_matmul`` overlap fraction, and the
+    explicit-FSDP-step loss parity against the ``parallel/zero.py``
+    annotation path.  CPU-measurable (the ring schedule's win on host
+    devices is never materialising the gathered operand); the wire-time
+    harvest lives in ``scripts/tpu_validation.py``'s ``collectives``
+    section."""
+    import subprocess
+
+    import jax
+
+    steps = int(os.environ.get("BENCH_COMM_STEPS", 5))
+    if len(jax.devices()) < 2:
+        # single-device process (the usual CPU-fallback worker): the mesh
+        # collectives need shards, so re-measure in a child with the
+        # 8-way forced-host CPU mesh — XLA_FLAGS must be set before the
+        # child imports jax, which is why this can't happen in-process
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "comm_bench.py"),
+             "--steps", str(steps), "--parity-steps",
+             os.environ.get("BENCH_COMM_PARITY_STEPS", "3")],
+            stdout=subprocess.PIPE, text=True, timeout=600, env=env)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"comm_bench subprocess exited {proc.returncode}")
+        rec = json.loads(proc.stdout)
+        rec["fallback"] = "cpu-subprocess-8dev"
+        return rec
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    import comm_bench
+
+    return comm_bench.run(
+        steps=steps,
+        parity_steps=int(os.environ.get("BENCH_COMM_PARITY_STEPS", 3)))
+
+
 def _attention_speedup(steps: int = 20) -> float | None:
     """Fused (Pallas flash) vs dense attention fwd+bwd at a long-context
     shape; returns flash/dense step-time ratio > 1 = flash faster.  TPU
@@ -958,6 +1006,33 @@ def main() -> None:
             print(f"bench: observability section failed "
                   f"({type(exc).__name__}: {exc})", file=sys.stderr)
 
+    # --- collectives: quantized + ring-overlapped FSDP comm layer ----------
+    collectives = None
+    t_comm = 90 if on_tpu else 60
+    if os.environ.get("BENCH_COMM", "1") != "0" and _time_left() < t_comm:
+        print(f"bench: shedding collectives section ({_time_left():.0f}s "
+              "left)", file=sys.stderr)
+    elif os.environ.get("BENCH_COMM", "1") != "0":
+        try:
+            with _section_timer("collectives"):
+                collectives = _collectives()
+            cvs = _vs_baseline(baselines,
+                               f"{platform}:comm_int8_bytes_reduction_v1",
+                               collectives["bytes"]["int8_reduction_x"],
+                               base_path)
+            collectives["vs_baseline"] = round(cvs, 4)
+            ofrac = collectives["overlap"]["overlap_fraction"]
+            if ofrac:
+                # only a nonzero fraction seeds/ratios the baseline: a
+                # loaded-box zero must not pin the record at 0 forever
+                collectives["overlap_vs_baseline"] = round(
+                    _vs_baseline(baselines,
+                                 f"{platform}:comm_overlap_fraction_v1",
+                                 ofrac, base_path), 4)
+        except Exception as exc:
+            print(f"bench: collectives section failed "
+                  f"({type(exc).__name__}: {exc})", file=sys.stderr)
+
     attn_speedup = None
     if on_tpu and os.environ.get("BENCH_ATTENTION", "1") != "0":
         if _time_left() < 90:
@@ -991,6 +1066,7 @@ def main() -> None:
         "autotune": autotune,
         "reshard": reshard,
         "observability": observability,
+        "collectives": collectives,
         "flash_attention_speedup":
             round(attn_speedup, 3) if attn_speedup else None,
         "section_secs": section_secs,
@@ -1100,7 +1176,7 @@ def orchestrate() -> int:
     shed = {"BENCH_SECONDARY": "0", "BENCH_LM": "0", "BENCH_INPUT": "0",
             "BENCH_ATTENTION": "0", "BENCH_SERVE": "0",
             "BENCH_RESILIENCE": "0", "BENCH_RESHARD": "0",
-            "BENCH_OBS": "0"}
+            "BENCH_OBS": "0", "BENCH_COMM": "0"}
     plan: list[dict] = [{}] if pinned else [
         {"BENCH_BATCH_PER_CHIP": "256"},
         {"BENCH_BATCH_PER_CHIP": "128", **shed},

@@ -33,10 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # JAX >= 0.7 exports shard_map at top level
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from distributed_deep_learning_tpu.runtime.shmap import shard_map
 
 StageFn = Callable[[Any, jnp.ndarray], jnp.ndarray]
 

@@ -52,6 +52,32 @@ def _replicated(tree: Any) -> Any:
     return jax.tree.map(lambda _: P(), tree)
 
 
+def _residual_specs(tree: Any) -> Any:
+    # error-feedback residuals (..parallel.collectives) carry a leading
+    # per-shard axis sharded over the batch axes: each device holds
+    # exactly its own quantization error
+    from distributed_deep_learning_tpu.data.loader import BATCH_AXES
+
+    return jax.tree.map(lambda _: P(BATCH_AXES), tree)
+
+
+def dp_state_spec(state: TrainState) -> TrainState:
+    """Pure data-parallel state: everything replicated EXCEPT the
+    error-feedback residual, which is per-shard by construction.  The
+    ``--grad-compress int8`` path needs this instead of a bare ``P()``:
+    placing the residual replicated while the compressed step returns it
+    batch-sharded breaks the step's buffer donation."""
+    return state.replace(
+        step=P(),
+        params=_replicated(state.params),
+        model_state=_replicated(state.model_state),
+        opt_state=_replicated(state.opt_state),
+        rng=P() if state.rng is not None else None,
+        sentinel=_replicated(state.sentinel),
+        comm_residual=_residual_specs(state.comm_residual),
+    )
+
+
 def zero1_state_spec(state: TrainState, mesh: Mesh, *, axis: str = "fsdp",
                      min_leaf_size: int = 2 ** 14) -> TrainState:
     """ZeRO-1: optimizer state sharded over `axis`; params replicated.
@@ -67,6 +93,7 @@ def zero1_state_spec(state: TrainState, mesh: Mesh, *, axis: str = "fsdp",
         opt_state=_tree_specs(state.opt_state, n, axis, min_leaf_size),
         rng=P() if state.rng is not None else None,
         sentinel=_replicated(state.sentinel),  # four scalars, replicated
+        comm_residual=_residual_specs(state.comm_residual),
     )
 
 
@@ -81,4 +108,5 @@ def fsdp_state_spec(state: TrainState, mesh: Mesh, *, axis: str = "fsdp",
         opt_state=_tree_specs(state.opt_state, n, axis, min_leaf_size),
         rng=P() if state.rng is not None else None,
         sentinel=_replicated(state.sentinel),  # four scalars, replicated
+        comm_residual=_residual_specs(state.comm_residual),
     )

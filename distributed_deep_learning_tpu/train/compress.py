@@ -37,27 +37,35 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_deep_learning_tpu.data.loader import BATCH_AXES
+from distributed_deep_learning_tpu.runtime.shmap import shard_map
 from distributed_deep_learning_tpu.train.objectives import prediction_metrics
 from distributed_deep_learning_tpu.train.state import TrainState
 
-try:  # JAX >= 0.7 exports shard_map at top level
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+
+def _psum_bf16(leaf, axes, residual=None):
+    """bf16 on the wire, f32 result.  No error feedback (the mantissa
+    rounding is unbiased enough that a residual buys nothing)."""
+    out = lax.psum(leaf.astype(jnp.bfloat16), axes).astype(leaf.dtype)
+    return out if residual is None else (out, residual)
 
 
-def _psum_bf16(leaf, axes):
-    """bf16 on the wire, f32 result."""
-    return lax.psum(leaf.astype(jnp.bfloat16), axes).astype(leaf.dtype)
+def _psum_int8(leaf, axes, residual=None):
+    """Common-scale symmetric int8 values, int32 reduction.
 
-
-def _psum_int8(leaf, axes):
-    """Common-scale symmetric int8 values, int32 reduction."""
-    amax = lax.pmax(jnp.max(jnp.abs(leaf)), axes)
+    With ``residual`` (the per-device error-feedback buffer from
+    :func:`..parallel.collectives.attach_residual`) last step's
+    quantization error is added back before quantizing and the new error
+    returned — the applied updates telescope to the true gradient sum,
+    so the estimator is unbiased across steps instead of per step."""
+    v = leaf if residual is None else leaf + residual
+    amax = lax.pmax(jnp.max(jnp.abs(v)), axes)
     scale = jnp.maximum(amax / 127.0, jnp.asarray(1e-30, leaf.dtype))
-    q = jnp.clip(jnp.round(leaf / scale), -127, 127).astype(jnp.int8)
+    q = jnp.clip(jnp.round(v / scale), -127, 127).astype(jnp.int8)
     summed = lax.psum(q.astype(jnp.int32), axes)
-    return (summed.astype(leaf.dtype)) * scale
+    out = (summed.astype(leaf.dtype)) * scale
+    if residual is None:
+        return out
+    return out, v - q.astype(leaf.dtype) * scale
 
 
 _REDUCERS = {"bf16": _psum_bf16, "int8": _psum_int8}
@@ -94,6 +102,12 @@ def make_compressed_step_fns(mesh: Mesh, loss_fn: Callable, *,
         # rng None-ness is static (pytree structure); pass the key as an
         # explicit shard_map operand — closures over traced values are not
         has_rng = state.rng is not None
+        # error feedback (int8 only): the per-device residual rides in
+        # TrainState with a leading per-shard axis, sharded over the
+        # batch axes — each replica sees exactly its own buffer
+        has_res = method == "int8" and state.comm_residual is not None \
+            and bool(axes)
+        res_spec = P(BATCH_AXES) if has_res else P()
         key = jax.random.fold_in(state.rng, state.step) if has_rng \
             else jax.random.key(0)
 
@@ -110,9 +124,9 @@ def make_compressed_step_fns(mesh: Mesh, loss_fn: Callable, *,
             return loss + aux, (prediction_metrics(pred, y, loss), new_ms)
 
         @partial(shard_map, mesh=mesh,
-                 in_specs=(P(), P(), P(), batch_spec, batch_spec),
-                 out_specs=(P(), P(), P()), check_vma=False)
-        def sync_grads(params, ms, key, x, y):
+                 in_specs=(P(), P(), P(), batch_spec, batch_spec, res_spec),
+                 out_specs=(P(), P(), P(), res_spec), check_vma=False)
+        def sync_grads(params, ms, key, x, y, res):
             if has_rng and axes:
                 # each data shard must draw an INDEPENDENT dropout mask
                 # (the GSPMD path masks the global batch in one draw)
@@ -124,7 +138,19 @@ def make_compressed_step_fns(mesh: Mesh, loss_fn: Callable, *,
             if axes:
                 # local grads are means over the LOCAL shard; compressed
                 # psum of those means / n == the global-batch mean
-                g = jax.tree.map(lambda l: reduce_leaf(l, axes) / n, g)
+                if has_res:
+                    res_local = jax.tree.map(lambda r: jnp.squeeze(r, 0),
+                                             res)
+                    pairs = jax.tree.map(
+                        lambda l, r: reduce_leaf(l, axes, residual=r),
+                        g, res_local)
+                    is_pair = lambda t: isinstance(t, tuple)  # noqa: E731
+                    g = jax.tree.map(lambda t: t[0] / n, pairs,
+                                     is_leaf=is_pair)
+                    res = jax.tree.map(lambda t: t[1][None], pairs,
+                                       is_leaf=is_pair)
+                else:
+                    g = jax.tree.map(lambda l: reduce_leaf(l, axes) / n, g)
                 metrics = {  # loss is a shard mean → average; counts sum
                     "loss": lax.psum(metrics["loss"], axes) / n,
                     "correct": lax.psum(metrics["correct"], axes),
@@ -133,22 +159,29 @@ def make_compressed_step_fns(mesh: Mesh, loss_fn: Callable, *,
                 new_ms = jax.tree.map(
                     lambda s: lax.psum(s.astype(jnp.float32), axes) / n
                     if jnp.issubdtype(s.dtype, jnp.floating) else s, new_ms)
-            return g, metrics, new_ms
+            return g, metrics, new_ms, res
 
-        grads, metrics, new_ms = sync_grads(state.params, state.model_state,
-                                            key, x, y)
-        return state.apply_gradients(grads, model_state=new_ms), metrics
+        res_in = state.comm_residual if has_res else jnp.zeros(())
+        grads, metrics, new_ms, new_res = sync_grads(
+            state.params, state.model_state, key, x, y, res_in)
+        state = state.apply_gradients(grads, model_state=new_ms)
+        if has_res:
+            state = state.replace(comm_residual=new_res)
+        return state, metrics
 
     def eval_step(state: TrainState, x, y):
         pred, _, _ = state.apply_fn(state.params, state.model_state, x,
                                     train=False)
         return prediction_metrics(pred, y, loss_fn(pred, y))
 
+    # state shardings are inferred (None), not pinned replicated: the
+    # error-feedback residual is per-device state that must stay sharded
+    # over the batch axes while everything else stays replicated
     train_step = jax.jit(train_step,
-                         in_shardings=(repl, batch_sh, batch_sh),
-                         out_shardings=(repl, repl),
+                         in_shardings=(None, batch_sh, batch_sh),
+                         out_shardings=(None, repl),
                          donate_argnums=(0,))
     eval_step = jax.jit(eval_step,
-                        in_shardings=(repl, batch_sh, batch_sh),
+                        in_shardings=(None, batch_sh, batch_sh),
                         out_shardings=repl)
     return train_step, eval_step

@@ -38,6 +38,10 @@ class TrainState:
     # unless attach_sentinel() was called; never checkpointed (a restore
     # starts the window fresh)
     sentinel: Any = None
+    # per-shard error-feedback residual for quantized collectives
+    # (..parallel.collectives.attach_residual): a params-shaped tree with
+    # a leading per-shard axis, None unless an int8 comm path is active
+    comm_residual: Any = None
 
     @classmethod
     def create(cls, *, apply_fn: Callable, params: Any,

@@ -19,7 +19,10 @@ from typing import Any
 from distributed_deep_learning_tpu.tune.space import Plan
 from distributed_deep_learning_tpu.utils.config import Config
 
-PLAN_SCHEMA_VERSION = 1
+#: v2: Plan grew the ``comm``/``comm_overlap`` axes (quantized +
+#: ring-overlapped FSDP collectives) — v1 artifacts predate them and
+#: must re-search, not silently replay without the new knobs
+PLAN_SCHEMA_VERSION = 2
 
 
 class StalePlanError(ValueError):

@@ -63,6 +63,10 @@ def analytic_score(plan: Plan) -> float:
         score *= 1.10                             # param+moment allgather
     if plan.grad_compress != "none":
         score *= 1.02                             # quantize/dequantize work
+    if plan.comm != "none":
+        score *= 1.02                             # quantize/dequantize work
+        if plan.comm_overlap:
+            score *= 0.99                         # ring hides wire time
     return score
 
 

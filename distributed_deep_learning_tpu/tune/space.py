@@ -42,6 +42,8 @@ class Plan:
     remat_policy: str = "nothing"
     zero: str = "none"
     grad_compress: str = "none"
+    comm: str = "none"
+    comm_overlap: bool = False
     attention: str = "auto"
     dtype: str = "float32"
 
@@ -74,6 +76,8 @@ class Plan:
             "remat_policy": self.remat_policy,
             "zero": self.zero,
             "grad_compress": self.grad_compress,
+            "comm": self.comm,
+            "comm_overlap": self.comm_overlap,
             "attention": self.attention,
             "dtype": self.dtype,
         }
@@ -100,6 +104,9 @@ class Plan:
             bits.append(f"zero={self.zero}")
         if self.grad_compress != "none":
             bits.append(f"compress={self.grad_compress}")
+        if self.comm != "none":
+            ring = "+ring" if self.comm_overlap else ""
+            bits.append(f"comm={self.comm}{ring}")
         if self.attention != "auto":
             bits.append(f"attention={self.attention}")
         bits.append(self.dtype)
@@ -129,7 +136,8 @@ def plan_from_config(config: Config, n_devices: int) -> Plan:
         mesh = _normalize_mesh({"data": 1})
     return Plan(mesh=mesh, grad_accum=config.grad_accum, remat=config.remat,
                 remat_policy=config.remat_policy, zero=config.zero,
-                grad_compress=config.grad_compress,
+                grad_compress=config.grad_compress, comm=config.comm,
+                comm_overlap=config.comm_overlap,
                 attention=config.attention, dtype=config.dtype)
 
 
@@ -165,6 +173,8 @@ def enumerate_plans(n_devices: int, batch_size: int, *,
                     zero_options: Sequence[str] = ("none", "1", "fsdp"),
                     compress_options: Sequence[str] = ("none", "bf16",
                                                        "int8"),
+                    comm_options: Sequence[str] = ("none", "bf16", "int8"),
+                    comm_overlap_options: Sequence[bool] = (False, True),
                     ) -> list[Plan]:
     """Enumerate the legal plan lattice, in deterministic order.
 
@@ -178,6 +188,8 @@ def enumerate_plans(n_devices: int, batch_size: int, *,
       traffic to compress
     * ZeRO needs a >1 shard axis (fsdp when present, else data) — sharding
       over a size-1 axis is a no-op plan already covered by ``none``
+    * ``--comm`` (explicit quantized FSDP collectives) needs ``zero=fsdp``
+      with no accumulation; ``--comm-overlap`` needs ``--comm``
     """
     plans: list[Plan] = []
     for mesh in _mesh_candidates(n_devices):
@@ -200,11 +212,20 @@ def enumerate_plans(n_devices: int, batch_size: int, *,
                         if compress != "none" and (
                                 zero != "none" or accum > 1 or dp <= 1):
                             continue
-                        for attention in attention_options:
-                            for dtype in dtypes:
-                                plans.append(Plan(
-                                    mesh=mesh, grad_accum=accum,
-                                    remat=remat, remat_policy=policy,
-                                    zero=zero, grad_compress=compress,
-                                    attention=attention, dtype=dtype))
+                        for comm in comm_options:
+                            if comm != "none" and (
+                                    zero != "fsdp" or accum > 1
+                                    or compress != "none"):
+                                continue
+                            for ring in comm_overlap_options:
+                                if ring and comm == "none":
+                                    continue
+                                for attention in attention_options:
+                                    for dtype in dtypes:
+                                        plans.append(Plan(
+                                            mesh=mesh, grad_accum=accum,
+                                            remat=remat, remat_policy=policy,
+                                            zero=zero, grad_compress=compress,
+                                            comm=comm, comm_overlap=ring,
+                                            attention=attention, dtype=dtype))
     return plans

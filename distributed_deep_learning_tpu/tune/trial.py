@@ -137,6 +137,7 @@ class TrialHarness:
                      if cfg.dropout > 0 else None)
         state = create_train_state(model, rng, example, tx,
                                    train_rng=train_rng)
+        state = base.attach_comm_residual(cfg, mesh, state)
         state_spec = base.derive_state_spec(self.spec, cfg, mesh, state)
         state = place_state(state, mesh, state_spec)
         train_step, _ = base.make_train_eval_steps(cfg, mesh, loss_fn,

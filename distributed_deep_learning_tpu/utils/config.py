@@ -152,6 +152,9 @@ class Config:
     zero: str = "none"                  # optimizer/param sharding: none|1|fsdp
     grad_compress: str = "none"         # gradient all-reduce wire format:
                                         #   none|bf16|int8 (train/compress.py)
+    comm: str = "none"                  # FSDP collective wire format:
+                                        #   none|bf16|int8 (parallel/collectives.py)
+    comm_overlap: bool = False          # ring-overlapped FSDP collectives
     grad_accum: int = 1                 # gradient-accumulation microsteps
     dropout: float = 0.0                # train-time dropout rate (north-star models)
     remat: bool = False                 # rematerialise activations in backward
@@ -316,6 +319,17 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
                         "bf16 halves wire bytes; int8 is common-scale "
                         "quantization with int32 reduction (EQuARX-style "
                         "numerics)")
+    p.add_argument("--comm", choices=["none", "bf16", "int8"],
+                   default="none",
+                   help="with --zero fsdp: quantize the explicit param "
+                        "all-gather / grad reduce-scatter collectives "
+                        "(bf16 halves wire bytes; int8 quarters them with "
+                        "per-leaf error-feedback residuals; "
+                        "parallel/collectives.py)")
+    p.add_argument("--comm-overlap", action="store_true",
+                   help="with --comm: run the FSDP collectives as "
+                        "double-buffered ppermute rings so each chunk's "
+                        "transfer overlaps the previous chunk's compute")
     p.add_argument("--checkpoint-dir", type=str, default=None)
     p.add_argument("--checkpoint-every", type=int, default=0,
                    help="also checkpoint every N train steps (0 = per "
@@ -624,6 +638,34 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         raise SystemExit(f"--mesh stage={mesh_shape['stage']} conflicts "
                          f"with --nstages {args.nstages}; drop one (--mesh "
                          "wins over the mode-derived stage count)")
+    if args.comm != "none":
+        if args.zero != "fsdp":
+            raise SystemExit("--comm quantizes the explicit FSDP param "
+                             "all-gather / grad reduce-scatter; it requires "
+                             "--zero fsdp (with no sharded params there is "
+                             "no such collective to compress)")
+        if args.grad_compress != "none":
+            raise SystemExit("--comm and --grad-compress are mutually "
+                             "exclusive: the FSDP dataflow has no pure "
+                             "gradient all-reduce for --grad-compress to "
+                             "act on")
+        if args.grad_accum > 1:
+            raise SystemExit("--comm does not compose with --grad-accum "
+                             "(the accumulation loop re-gathers params per "
+                             "microstep; quantizing those repeats is not "
+                             "implemented)")
+        bad = [a for a in ("model", "expert", "stage", "seq")
+               if (mesh_shape or {}).get(a, 0) > 1]
+        if bad:
+            raise SystemExit(f"--comm requires a data/fsdp-only mesh; got "
+                             f"{'/'.join(bad)} axes (the explicit FSDP "
+                             "step owns the whole dataflow and does not "
+                             "compose with model/expert/stage/seq "
+                             "sharding)")
+    if args.comm_overlap and args.comm == "none":
+        raise SystemExit("--comm-overlap requires --comm bf16|int8 (it "
+                         "selects the ring schedule for the explicit "
+                         "collectives --comm turns on)")
     if args.plan_file and not args.autotune and not os.path.exists(args.plan_file):
         raise SystemExit(f"--plan {args.plan_file}: no such file (run "
                          "--autotune to produce one)")
@@ -670,6 +712,8 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         sync_in_local_data_mode=args.sync,
         zero=args.zero,
         grad_compress=args.grad_compress,
+        comm=args.comm,
+        comm_overlap=args.comm_overlap,
         grad_accum=args.grad_accum,
         dropout=args.dropout,
         remat=args.remat,

@@ -406,16 +406,58 @@ def derive_state_spec(spec: WorkloadSpec, config: Config, mesh, state):
         make_spec = zero1_state_spec if config.zero == "1" \
             else fsdp_state_spec
         state_spec = make_spec(state, mesh, axis=axis)
+    elif getattr(state, "comm_residual", None) is not None:
+        # pure DP with an int8 error-feedback residual (--grad-compress
+        # int8): replicated state, but the residual is per-shard and must
+        # be PLACED that way or the compressed step's donation breaks
+        from distributed_deep_learning_tpu.parallel.zero import (
+            dp_state_spec)
+
+        state_spec = dp_state_spec(state)
     return state_spec
 
 
+def attach_comm_residual(config: Config, mesh, state):
+    """Zero-init the error-feedback residual on ``state`` when an int8
+    communication path is active (``--comm int8`` or ``--grad-compress
+    int8``).  Must run BEFORE deriving sharding specs — the zero/
+    spec builders map ``comm_residual`` alongside the other fields."""
+    if config.comm != "int8" and config.grad_compress != "int8":
+        return state
+    from distributed_deep_learning_tpu.parallel.collectives import (
+        attach_residual)
+
+    n = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+    if n <= 1:
+        return state   # single shard: nothing crosses the wire
+    return attach_residual(state, n)
+
+
 def make_train_eval_steps(config: Config, mesh, loss_fn, state_spec,
-                          sentinel=None):
+                          sentinel=None, registry=None):
     """(train_step, eval_step) for the SEQUENTIAL/DATA family, dispatching
     to the compressed / accumulating / plain step builders exactly as the
     trainer does (flag combinations the builders cannot honour are
     rejected, not silently dropped).  Shared with the tune/ trial harness.
     """
+    if config.comm != "none":
+        if config.zero != "fsdp" or config.grad_accum > 1 \
+                or config.grad_compress != "none" \
+                or mesh.shape.get("model", 1) > 1 \
+                or mesh.shape.get("expert", 1) > 1:
+            raise ValueError(
+                "--comm quantizes the explicit FSDP collectives "
+                "(parallel/collectives.py); it requires --zero fsdp and "
+                "does not compose with --grad-accum/--grad-compress/"
+                "--mesh model/expert axes")
+        from distributed_deep_learning_tpu.parallel.collectives import (
+            make_fsdp_step_fns)
+
+        axis = "fsdp" if mesh.shape.get("fsdp", 1) > 1 else "data"
+        return make_fsdp_step_fns(
+            mesh, loss_fn, state_spec=state_spec, method=config.comm,
+            overlap=config.comm_overlap, axis=axis, remat=config.remat,
+            remat_policy=config.remat_policy, registry=registry)
     if config.grad_compress != "none":
         if config.zero != "none" or config.grad_accum > 1 \
                 or mesh.shape.get("model", 1) > 1 \
@@ -423,7 +465,9 @@ def make_train_eval_steps(config: Config, mesh, loss_fn, state_spec,
             raise ValueError(
                 "--grad-compress applies to the pure data-parallel "
                 "gradient all-reduce; it does not compose with "
-                "--zero/--grad-accum/--mesh model/expert axes")
+                "--zero/--grad-accum/--mesh model/expert axes (for "
+                "compressed ZeRO/FSDP collectives use --comm bf16|int8, "
+                "parallel/collectives.py)")
         from distributed_deep_learning_tpu.train.compress import (
             make_compressed_step_fns)
 
@@ -468,7 +512,8 @@ def _sentinel_config(config: Config):
     from distributed_deep_learning_tpu.train.sentinel import SentinelConfig
 
     unsupported = [(config.grad_accum > 1, "--grad-accum"),
-                   (config.grad_compress != "none", "--grad-compress")]
+                   (config.grad_compress != "none", "--grad-compress"),
+                   (config.comm != "none", "--comm")]
     bad = [flag for cond, flag in unsupported if cond]
     if bad:
         raise ValueError(f"--sentinel does not compose with "
@@ -953,10 +998,12 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
             # attach BEFORE deriving sharding specs: the spec builders map
             # the sentinel scalars to replicated specs alongside the rest
             state = attach_sentinel(state)
+        state = attach_comm_residual(config, mesh, state)
         state_spec = derive_state_spec(spec, config, mesh, state)
         state = place_state(state, mesh, state_spec)
         train_step, eval_step = make_train_eval_steps(
-            config, mesh, loss_fn, state_spec, sentinel=sentinel)
+            config, mesh, loss_fn, state_spec, sentinel=sentinel,
+            registry=telemetry.registry if telemetry is not None else None)
         if telemetry is not None:
             _measure_train_flops(telemetry, train_step, state, loaders[0],
                                  n_devices=mesh.size)
@@ -981,6 +1028,7 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
                         attach_sentinel)
 
                     s = attach_sentinel(s)
+                s = attach_comm_residual(config, mesh, s)
                 return place_state(s, mesh, state_spec)
 
             return _fit_elastic(config, logger, make_state, train_step,

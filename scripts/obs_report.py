@@ -46,11 +46,37 @@ def _goodput_block(gp: dict, indent: str = "  ") -> list[str]:
     return lines
 
 
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}GiB"  # pragma: no cover
+
+
+def _comm_block(snapshot: dict) -> list[str]:
+    """Collective wire traffic: ``comm_bytes{method,op}`` counters from
+    the explicit FSDP step (parallel/collectives.py) plus the measured
+    ring-overlap fraction gauge when a comm bench ran."""
+    counters = snapshot.get("counters", {})
+    gauges = snapshot.get("gauges", {})
+    lines = []
+    for key in sorted(counters):
+        if key.startswith("comm_bytes{"):
+            labels = key[len("comm_bytes{"):-1]
+            lines.append(f"  {labels:<38}{_fmt_bytes(counters[key]):>12}")
+    frac = gauges.get("comm_overlap_fraction")
+    if frac is not None:
+        lines.append(f"  overlap fraction {_fmt_frac(frac)}")
+    return lines
+
+
 def render(events: list[dict], phases: bool = False) -> str:
     run_gp = None
     phase_gps = []
     mfu = None
     serve = []
+    snapshot = None
     for ev in events:
         kind = ev.get("event")
         if kind == "obs_goodput":
@@ -62,6 +88,8 @@ def render(events: list[dict], phases: bool = False) -> str:
             mfu = ev
         elif kind == "obs_serve":
             serve.append(ev.get("stats", {}))
+        elif kind == "obs_snapshot":
+            snapshot = ev.get("snapshot", {})
 
     out = []
     if run_gp is not None:
@@ -88,6 +116,11 @@ def render(events: list[dict], phases: bool = False) -> str:
         else:
             out.append("  MFU             n/a (no peak-FLOPs table entry "
                        "for this device; set DDL_OBS_PEAK_FLOPS)")
+    if snapshot is not None:
+        comm = _comm_block(snapshot)
+        if comm:
+            out.append("== collective wire traffic ==")
+            out += comm
     for st in serve:
         lat = st.get("latency") or {}
         out.append("== serving latency ==")

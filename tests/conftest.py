@@ -49,7 +49,7 @@ SMOKE_FILES = {
     "test_profiling.py", "test_schedules.py", "test_compress.py",
     "test_host_pipeline.py", "test_attention_pallas.py",
     "test_torch_migrate.py", "test_chaos.py", "test_tune.py",
-    "test_reshard.py", "test_obs.py",
+    "test_reshard.py", "test_obs.py", "test_collectives.py",
 }
 
 

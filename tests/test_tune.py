@@ -31,10 +31,10 @@ GEOM = ModelGeometry(param_count=1_000_000, num_layers=4,
 # ---------------------------------------------------------------- lattice
 
 def test_lattice_count_anchor():
-    # 3 (data,fsdp) factorizations of 4, 23 legal knob combos each; pinned
-    # so an accidental legality change shows up as a count change
-    assert len(enumerate_plans(4, 32)) == 69
-    assert len(enumerate_plans(8, 32)) == 92
+    # pinned so an accidental legality change shows up as a count change;
+    # the comm axis adds 4 variants (bf16/int8 x plain/ring) per fsdp plan
+    assert len(enumerate_plans(4, 32)) == 117
+    assert len(enumerate_plans(8, 32)) == 156
 
 
 def test_lattice_plans_unique_and_hashable():
@@ -58,6 +58,11 @@ def test_lattice_legality_invariants():
             shard = md.get("fsdp", 1) if md.get("fsdp", 1) > 1 \
                 else md.get("data", 1)
             assert shard > 1
+        if p.comm != "none":             # explicit collectives need fsdp
+            assert p.zero == "fsdp" and p.grad_accum == 1
+            assert p.grad_compress == "none"
+        if p.comm_overlap:               # ring schedule needs --comm
+            assert p.comm != "none"
 
 
 def test_lattice_indivisible_batch_is_empty():
@@ -199,6 +204,20 @@ def test_artifact_rejects_foreign_version(tmp_path):
         load_plan(path)
 
 
+def test_artifact_rejects_v1_pre_comm_plans(tmp_path):
+    # schema v1 artifacts predate the comm/comm_overlap plan axes; they
+    # must be rejected for re-search, not silently replayed without them
+    assert artifact_mod.PLAN_SCHEMA_VERSION == 2
+    path = str(tmp_path / "p.plan.json")
+    save_plan(path, _plan(), key="k", workload="mlp")
+    rec = json.load(open(path))
+    rec["version"] = 1
+    del rec["plan"]["comm"], rec["plan"]["comm_overlap"]
+    json.dump(rec, open(path, "w"))
+    with pytest.raises(StalePlanError, match="schema version"):
+        load_plan(path)
+
+
 def test_artifact_rejects_edited_plan(tmp_path):
     path = str(tmp_path / "p.plan.json")
     save_plan(path, _plan(), key="k", workload="mlp")
@@ -227,8 +246,8 @@ def test_search_with_injected_measure_best_wins():
     assert result.best_sps >= result.baseline_sps
     assert result.best_sps == max(
         t.steps_per_sec for t in result.trials if not t.infeasible)
-    assert result.n_candidates == 92 and result.n_pruned == 0
-    assert result.n_capped == 92 - 8
+    assert result.n_candidates == 156 and result.n_pruned == 0
+    assert result.n_capped == 156 - 8
     assert result.rungs >= 1
 
 
