@@ -571,11 +571,15 @@ def _observability() -> dict | None:
     reads + dict adds either way).  The acceptance bar is overhead
     < 2%; the measured fraction is tracked under
     ``{platform}:obs_overhead_fraction_v1``."""
-    from distributed_deep_learning_tpu.obs.bench import overhead_bench
+    from distributed_deep_learning_tpu.obs.bench import (overhead_bench,
+                                                         trace_overhead_bench)
 
-    return overhead_bench(
-        steps=int(os.environ.get("BENCH_OBS_STEPS", 48)),
-        repeats=int(os.environ.get("BENCH_OBS_REPEATS", 5)))
+    steps = int(os.environ.get("BENCH_OBS_STEPS", 48))
+    repeats = int(os.environ.get("BENCH_OBS_REPEATS", 5))
+    rec = overhead_bench(steps=steps, repeats=repeats)
+    # gen-2 increment (ISSUE 11): spans on vs off, same loop, same bar
+    rec["trace"] = trace_overhead_bench(steps=steps, repeats=repeats)
+    return rec
 
 
 def _collectives() -> dict | None:
@@ -710,8 +714,110 @@ def _recorded_mfu(baselines: dict) -> float | None:
     return float(v) if isinstance(v, (int, float)) and v > 0 else None
 
 
+#: Every baseline-tracked value this run actually measured (key ->
+#: value), recorded by ``_vs_baseline`` — what the regression sentry
+#: walks.  A section that errored or was shed simply never lands here,
+#: so the sentry only judges numbers that exist.
+_MEASURED: dict[str, float] = {}
+
+#: Noise-aware tolerance bands per baseline-key suffix (ISSUE 11).
+#: ``("higher", band)``: the metric should stay >= baseline * (1-band);
+#: the band is sized to each harness's observed run-to-run noise on a
+#: loaded CI box (throughputs swing hard, analytic ratios barely move).
+#: ``("lower_abs", ceiling)``: an absolute ceiling for
+#: lower-is-better fractions — the obs overheads are ~0.01-0.02 with
+#: noise of the same magnitude, so a ratio against a near-zero baseline
+#: would be meaningless; the acceptance bar (2% + measurement slack)
+#: is the honest gate.
+REGRESSION_BANDS: dict[str, tuple[str, float]] = {
+    "resnet50_224_train_v1": ("higher", 0.30),
+    "densenet_bc_train_v2": ("higher", 0.30),
+    "causal_lm_2048_train_v1": ("higher", 0.30),
+    "serving_tokens_per_sec_v1": ("higher", 0.30),
+    "serving_prefix_hit_rate_v1": ("higher", 0.10),
+    "serving_slo_attainment_v1": ("higher", 0.25),
+    "serving_spec_acceptance_v1": ("higher", 0.25),
+    "autotune_mlp_steps_per_sec_v1": ("higher", 0.30),
+    "reshard_chunked_gb_per_sec_v1": ("higher", 0.35),
+    "comm_int8_bytes_reduction_v1": ("higher", 0.05),
+    "comm_overlap_fraction_v1": ("higher", 0.40),
+    "obs_overhead_fraction_v1": ("lower_abs", 0.025),
+    "obs_trace_overhead_fraction_v1": ("lower_abs", 0.025),
+}
+
+
+def regression_sentry(baselines: dict,
+                      measured: dict | None = None) -> list[dict]:
+    """Compare this run's measured values against their recorded
+    baselines with per-metric tolerance bands; return one failure dict
+    per breach (empty list = clean).
+
+    A freshly seeded baseline compares at ratio 1.0 and can never fail —
+    the first measurement defines the record, later runs defend it."""
+    measured = _MEASURED if measured is None else measured
+    failures: list[dict] = []
+    for key in sorted(measured):
+        value = measured[key]
+        rule = REGRESSION_BANDS.get(key.split(":", 1)[-1])
+        if rule is None:
+            continue
+        direction, band = rule
+        if direction == "lower_abs":
+            if value > band:
+                failures.append({
+                    "key": key, "value": value, "ceiling": band,
+                    "kind": "absolute ceiling exceeded"})
+            continue
+        base = baselines.get(key)
+        if not isinstance(base, (int, float)) or base <= 0:
+            continue
+        ratio = value / base
+        if ratio < 1.0 - band:
+            failures.append({
+                "key": key, "value": value, "baseline": base,
+                "ratio": round(ratio, 4), "band": band,
+                "kind": "below tolerance band"})
+    return failures
+
+
+def regress_from(path: str) -> int:
+    """The cheap CI gate (``BENCH_REGRESS_FROM=rec.json python
+    bench.py``): judge a previously recorded bench JSON line against the
+    current baselines WITHOUT running any benches.  Reads the line's
+    ``measured`` map (every ``_vs_baseline`` datum of that run), applies
+    the same tolerance bands, exits 3 on breach / 2 on an unusable
+    record / 0 clean."""
+    measured: dict[str, float] = {}
+    try:
+        with open(path) as f:
+            for raw in f:
+                raw = raw.strip()
+                if raw.startswith("{"):
+                    measured.update(json.loads(raw).get("measured") or {})
+    except (OSError, ValueError) as e:
+        print(f"bench: cannot read record {path}: {e}", file=sys.stderr)
+        return 2
+    if not measured:
+        print(f"bench: no 'measured' map in {path} (older record "
+              "format? re-run bench.py to produce one)", file=sys.stderr)
+        return 2
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "bench_baseline.json")
+    baselines = {}
+    if os.path.exists(base_path):
+        with open(base_path) as f:
+            baselines = json.load(f)
+    regs = regression_sentry(baselines, measured)
+    for r in regs:
+        print(f"bench: REGRESSION {r['key']}: {r}", file=sys.stderr)
+    print(json.dumps({"regress_from": path, "checked": len(measured),
+                      "regressions": regs}))
+    return 3 if regs else 0
+
+
 def _vs_baseline(baselines: dict, key: str, value: float,
                  base_path: str) -> float:
+    _MEASURED[key] = value
     if key not in baselines:
         baselines[key] = value
         try:
@@ -722,7 +828,7 @@ def _vs_baseline(baselines: dict, key: str, value: float,
     return value / baselines[key] if baselines[key] else 1.0
 
 
-def main() -> None:
+def main() -> int:
     _enable_compile_cache()
     section_secs: dict[str, float] = {}
 
@@ -1002,6 +1108,11 @@ def main() -> None:
                                observability["obs_overhead_fraction"],
                                base_path)
             observability["vs_baseline"] = round(ovs, 4)
+            tvs = _vs_baseline(
+                baselines, f"{platform}:obs_trace_overhead_fraction_v1",
+                observability["trace"]["obs_trace_overhead_fraction"],
+                base_path)
+            observability["trace"]["vs_baseline"] = round(tvs, 4)
         except Exception as exc:
             print(f"bench: observability section failed "
                   f"({type(exc).__name__}: {exc})", file=sys.stderr)
@@ -1071,6 +1182,19 @@ def main() -> None:
             round(attn_speedup, 3) if attn_speedup else None,
         "section_secs": section_secs,
     }
+    # --- perf-regression sentry (ISSUE 11) --------------------------------
+    # Every measured value is judged against its recorded baseline with a
+    # noise-aware band; breaches always WARN loudly on stderr and ride
+    # the JSON line.  BENCH_REGRESS=1 turns breaches into exit code 3
+    # (the CI gate) — run it worker-direct (BENCH_REGRESS=1 python
+    # bench.py), optionally shedding sections with the BENCH_* toggles.
+    regressions = regression_sentry(baselines)
+    line["regressions"] = regressions
+    # every datum this run measured, flat — what BENCH_REGRESS_FROM
+    # re-judges later without re-running the benches
+    line["measured"] = {k: _MEASURED[k] for k in sorted(_MEASURED)}
+    for r in regressions:
+        print(f"bench: REGRESSION {r['key']}: {r}", file=sys.stderr)
     if not on_tpu:
         # CPU fallback: carry the RECORDED hardware history (labelled as
         # such — these are prior measured baselines from
@@ -1081,6 +1205,11 @@ def main() -> None:
         if recorded:
             line["recorded_tpu"] = recorded
     print(json.dumps(line))
+    if regressions and os.environ.get("BENCH_REGRESS") == "1":
+        print(f"bench: {len(regressions)} regression(s) vs baseline; "
+              "failing (BENCH_REGRESS=1)", file=sys.stderr)
+        return 3
+    return 0
 
 
 def orchestrate() -> int:
@@ -1208,7 +1337,14 @@ if __name__ == "__main__":
         float(jnp.sum(x @ x))
         print("probe-ok")
         sys.exit(0)
+    if os.environ.get("BENCH_REGRESS_FROM"):
+        # judge an existing record against the baselines — no benches run
+        sys.exit(regress_from(os.environ["BENCH_REGRESS_FROM"]))
     if os.environ.get("BENCH_WORKER") == "1" or \
-            os.environ.get("BENCH_NO_WATCHDOG") == "1":
+            os.environ.get("BENCH_NO_WATCHDOG") == "1" or \
+            os.environ.get("BENCH_REGRESS") == "1":
+        # BENCH_REGRESS runs worker-direct: the orchestrator would treat
+        # the sentry's exit 3 as a transport failure and retry on CPU,
+        # swallowing the very signal the gate exists to surface
         sys.exit(main())
     sys.exit(orchestrate())

@@ -25,10 +25,12 @@ from typing import Any, Callable
 from .export import EventWriter
 from .metrics import MetricsRegistry
 from .mfu import chip_peak_flops, measure_step_flops, mfu_record
+from .recorder import FlightRecorder
 from .timeline import Timeline
+from .trace import Tracer
 
 __all__ = ["RunTelemetry", "MetricsRegistry", "Timeline", "EventWriter",
-           "chip_peak_flops"]
+           "Tracer", "FlightRecorder", "chip_peak_flops"]
 
 
 class RunTelemetry:
@@ -36,13 +38,33 @@ class RunTelemetry:
 
     ``path=None`` keeps the full accounting in memory without a sidecar
     (tests, the overhead harness); instruments stay live either way.
+
+    Generation 2 (ISSUE 11): ``trace_path`` turns on the per-request /
+    per-step span :class:`~.trace.Tracer` (exported as a Chrome/Perfetto
+    trace on :meth:`close`); ``recorder`` attaches a
+    :class:`~.recorder.FlightRecorder` the train loop and serve engines
+    feed (sentinel anomalies, SLO breaches) so a dying run leaves a
+    black box.  ``rotate_mb`` size-caps the JSONL sidecar (see
+    :class:`~.export.EventWriter`).
     """
 
     def __init__(self, path: str | None = None,
-                 clock=time.perf_counter) -> None:
+                 clock=time.perf_counter, *,
+                 trace_path: str | None = None,
+                 tracer: "Tracer | None" = None,
+                 recorder: "FlightRecorder | None" = None,
+                 rotate_mb: float | None = None,
+                 fsync_on_rollover: bool = False) -> None:
         self.registry = MetricsRegistry()
-        self.timeline = Timeline(clock=clock)
-        self.writer = EventWriter(path, clock=clock)
+        self.tracer = tracer if tracer is not None else (
+            Tracer(clock=clock) if trace_path else None)
+        self.trace_path = trace_path
+        self.recorder = recorder
+        self.timeline = Timeline(clock=clock, tracer=self.tracer)
+        self.writer = EventWriter(
+            path, clock=clock,
+            max_bytes=int(rotate_mb * 1e6) if rotate_mb else None,
+            fsync_on_rollover=fsync_on_rollover)
         self.clock = clock
         # model-FLOP state (filled by measure_flops / note_train)
         self.step_flops: float | None = None
@@ -115,5 +137,11 @@ class RunTelemetry:
         self.writer.emit("obs_goodput", scope="run", **gp)
         self.writer.emit("obs_mfu", **rec)
         self.writer.emit("obs_snapshot", snapshot=snap)
+        summary = {"goodput": gp, "mfu": rec, "snapshot": snap}
+        if self.tracer is not None and self.trace_path:
+            n = self.tracer.export(self.trace_path)
+            self.writer.emit("obs_trace", path=self.trace_path, spans=n,
+                             dropped=self.tracer.dropped)
+            summary["trace"] = {"path": self.trace_path, "spans": n}
         self.writer.close()
-        return {"goodput": gp, "mfu": rec, "snapshot": snap}
+        return summary

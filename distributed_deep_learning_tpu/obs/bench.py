@@ -93,3 +93,46 @@ def overhead_bench(*, steps: int = 48, repeats: int = 5, dim: int = 256,
         "per_step_overhead_us": round(
             (1.0 / sps_on - 1.0 / sps_off) * 1e6, 2),
     }
+
+
+def trace_overhead_bench(*, steps: int = 48, repeats: int = 5,
+                         dim: int = 256, depth: int = 4, batch: int = 64,
+                         seed: int = 0) -> dict:
+    """Gen-2 A/B (ISSUE 11): telemetry WITH span tracing vs telemetry
+    without, on the real train loop.
+
+    ``overhead_bench`` prices the gen-1 instruments against a bare run;
+    this prices the tracer increment — every Timeline.add now also
+    records a causal span (one extra clock read + one Span append).
+    The acceptance bar is < 2% of steps/sec; bench.py records
+    ``obs_trace_overhead_fraction`` under the
+    ``{platform}:obs_trace_overhead_fraction_v1`` baseline key."""
+    from distributed_deep_learning_tpu.obs import RunTelemetry, Tracer
+
+    step, state, (x, y) = _build_step(dim, depth, batch, seed)
+    loader = [(x, y)] * steps
+    _phase_sps(step, state, loader[:2], 2, None)   # compile warm
+
+    plain, traced = [], []
+    for _ in range(repeats):
+        plain.append(_phase_sps(step, state, loader, steps,
+                                RunTelemetry(path=None)))
+        traced.append(_phase_sps(step, state, loader, steps,
+                                 RunTelemetry(path=None,
+                                              tracer=Tracer())))
+    plain.sort()
+    traced.sort()
+    sps_plain = plain[len(plain) // 2]
+    sps_traced = traced[len(traced) // 2]
+    frac = 1.0 - sps_traced / sps_plain
+    return {
+        "metric": "span-tracing overhead (steps/sec traced vs untraced "
+                  "telemetry)",
+        "steps": steps, "repeats": repeats,
+        "step_geometry": {"dim": dim, "depth": depth, "batch": batch},
+        "steps_per_sec_plain": round(sps_plain, 2),
+        "steps_per_sec_traced": round(sps_traced, 2),
+        "obs_trace_overhead_fraction": round(frac, 5),
+        "per_step_overhead_us": round(
+            (1.0 / sps_traced - 1.0 / sps_plain) * 1e6, 2),
+    }

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import time
 from typing import Any, Iterator
 
@@ -30,13 +31,29 @@ class EventWriter:
 
     Safe to construct with ``path=None`` (all writes become no-ops), so
     call sites never need their own ``if telemetry`` guards.
+
+    ``max_bytes`` caps the live file: when an emit pushes it past the
+    cap the file ROTATES — ``path`` is renamed to ``path.1`` (older
+    generations shifting to ``path.2`` … ``path.{keep}``, the oldest
+    dropped) and a fresh ``path`` is opened, so a multi-hour run holds
+    at most ``(keep + 1) * max_bytes`` of sidecar.  Rotation happens on
+    line boundaries — every generation is a well-formed JSONL file in
+    the unchanged grammar.  ``fsync_on_rollover`` additionally fsyncs
+    the closing generation before the rename, so a power cut can only
+    lose lines from the CURRENT generation.
     """
 
-    def __init__(self, path: str | None,
-                 clock=time.perf_counter) -> None:
+    def __init__(self, path: str | None, clock=time.perf_counter,
+                 max_bytes: int | None = None, keep: int = 3,
+                 fsync_on_rollover: bool = False) -> None:
         self.path = path
         self.clock = clock
+        self.max_bytes = int(max_bytes) if max_bytes else None
+        self.keep = max(1, int(keep))
+        self.fsync_on_rollover = fsync_on_rollover
+        self.rollovers = 0
         self._fh = open(path, "a", buffering=1) if path else None
+        self._bytes = os.path.getsize(path) if path else 0
 
     def emit(self, event: str, **fields: Any) -> None:
         if self._fh is None:
@@ -53,6 +70,24 @@ class EventWriter:
             line = json.dumps(_scrub(rec), default=_json_default,
                               allow_nan=False)
         self._fh.write(line + "\n")
+        if self.max_bytes is not None:
+            self._bytes += len(line) + 1
+            if self._bytes >= self.max_bytes:
+                self._rollover()
+
+    def _rollover(self) -> None:
+        self._fh.flush()
+        if self.fsync_on_rollover:
+            os.fsync(self._fh.fileno())
+        self._fh.close()
+        for gen in range(self.keep - 1, 0, -1):
+            src = f"{self.path}.{gen}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{gen + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        self._fh = open(self.path, "a", buffering=1)
+        self._bytes = 0
+        self.rollovers += 1
 
     def close(self) -> None:
         if self._fh is not None:
@@ -103,6 +138,21 @@ def read_events(path: str, event: str | None = None) -> Iterator[dict]:
                 yield rec
 
 
+def read_rotated(path: str, event: str | None = None) -> Iterator[dict]:
+    """Like :func:`read_events` but chaining rotated generations oldest
+    first (``path.N`` … ``path.1``, then the live ``path``), so a
+    size-capped run's whole retained history reads as one stream."""
+    gen = 1
+    older: list[str] = []
+    while os.path.exists(f"{path}.{gen}"):
+        older.append(f"{path}.{gen}")
+        gen += 1
+    for p in reversed(older):
+        yield from read_events(p, event)
+    if os.path.exists(path):
+        yield from read_events(path, event)
+
+
 def _prom_name(key: str) -> tuple[str, str]:
     """Split a registry key ``name{a=b}`` into (metric name, label part
     incl. braces or empty), quoting label values per the exposition
@@ -133,7 +183,10 @@ def prometheus_text(snapshot: dict) -> str:
     lines: list[str] = []
     for key, v in sorted(snapshot.get("counters", {}).items()):
         name, labels = _prom_name(key)
-        lines.append(f"# TYPE {name} counter")
+        # classic text format: the TYPE line names the sample family
+        # (name_total), not the bare metric — a mismatch reads as
+        # untyped to strict parsers
+        lines.append(f"# TYPE {name}_total counter")
         lines.append(f"{name}_total{labels} {_fmt(v)}")
     for key, v in sorted(snapshot.get("gauges", {}).items()):
         name, labels = _prom_name(key)

@@ -53,10 +53,20 @@ CATEGORIES = ("productive", "input_stall", "checkpoint", "recovery",
 
 class Timeline:
     """Accumulates (seconds, count) per span kind against a wall-clock
-    origin.  ``clock`` is injectable for deterministic tests."""
+    origin.  ``clock`` is injectable for deterministic tests.
 
-    def __init__(self, clock=time.perf_counter) -> None:
+    ``tracer`` (:class:`..obs.trace.Tracer`, optional) additionally
+    records every ``add`` as a causal span on the ``train`` track —
+    the step/compile/checkpoint spans of the exported trace.  The end
+    time is read from the shared clock at add time (``add`` receives a
+    duration, not endpoints), costing one extra clock read per span —
+    only when tracing is on; the tracer-less path is unchanged."""
+
+    def __init__(self, clock=time.perf_counter, tracer=None,
+                 trace_id: str = "train") -> None:
         self.clock = clock
+        self.tracer = tracer
+        self.trace_id = trace_id
         self.seconds: dict[str, float] = {}
         self.counts: dict[str, int] = {}
         self.steps = 0
@@ -65,6 +75,10 @@ class Timeline:
     def add(self, kind: str, dt: float, n: int = 1) -> None:
         self.seconds[kind] = self.seconds.get(kind, 0.0) + dt
         self.counts[kind] = self.counts.get(kind, 0) + n
+        if self.tracer is not None:
+            t1 = self.clock()
+            self.tracer.add(kind, t1 - dt, t1, self.trace_id,
+                            track="train")
 
     @contextmanager
     def span(self, kind: str):

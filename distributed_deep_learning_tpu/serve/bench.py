@@ -161,7 +161,8 @@ def serving_bench(*, seed: int = 0, n_requests: int = 32,
                   new_tokens: tuple[int, int] = (4, 64),
                   max_slots: int = 8,
                   prefill_buckets: Optional[Sequence[int]] = None,
-                  stagger: int = 0, skip_naive: bool = False) -> dict:
+                  stagger: int = 0, skip_naive: bool = False,
+                  telemetry=None) -> dict:
     """The full A/B at one configuration; returns the ``serving``
     record ``bench.py`` embeds and ``scripts/serve_bench.py`` prints."""
     model, params = build_model(seed, **(model_kw or {}))
@@ -173,8 +174,8 @@ def serving_bench(*, seed: int = 0, n_requests: int = 32,
                        prompt_lens=prompt_lens, new_tokens=new_tokens,
                        stagger=stagger)
 
-    eng = run_engine(model, params, trace, max_slots=max_slots,
-                     prefill_buckets=prefill_buckets)
+    eng = run_engine(model, params, trace, telemetry=telemetry,
+                     max_slots=max_slots, prefill_buckets=prefill_buckets)
     es = eng["stats"]
     record = {
         "metric": "serving throughput tokens/sec (mixed-length trace)",

@@ -39,6 +39,7 @@ from distributed_deep_learning_tpu.models.transformer import (
     CausalLM, cached_apply, make_decode_model, sample_tokens,
     validate_sampling)
 from distributed_deep_learning_tpu.obs.metrics import MetricsRegistry
+from distributed_deep_learning_tpu.obs.window import LiveSignals
 from distributed_deep_learning_tpu.serve import cache as slot_cache
 from distributed_deep_learning_tpu.serve import paged
 from distributed_deep_learning_tpu.serve import spec as spec_mod
@@ -233,6 +234,15 @@ class ServeEngine:
         g_occ = reg.gauge("serve_slot_occupancy")
         first_wall: dict[int, float] = {}  # uid -> first-token wall time
 
+        tracer = getattr(telemetry, "tracer", None) \
+            if telemetry is not None else None
+        recorder = getattr(telemetry, "recorder", None) \
+            if telemetry is not None else None
+        live = LiveSignals()
+        root_span: dict[int, int] = {}       # uid -> open request span
+        last_tok_wall: dict[int, float] = {}  # uid -> last emit wall
+        last_window_emit = -float("inf")
+
         def retire(req, now):
             """Observe a retired request's TTFT-anchored latencies."""
             arr = sched.arrival_wall.get(req.uid, now)
@@ -241,6 +251,15 @@ class ServeEngine:
             fw = first_wall.pop(req.uid, None)
             if fw is not None and n_tok > 1:
                 h_itl.observe((now - fw) / (n_tok - 1))
+            last_tok_wall.pop(req.uid, None)
+            if tracer is not None:
+                rid = root_span.pop(req.uid, None)
+                tracer.add("retire", now, now, req.trace_id, parent=rid,
+                           track=f"req{req.uid}", tokens=n_tok)
+                if rid is not None:
+                    tracer.end(rid, t1=now)
+            if recorder is not None:
+                recorder.record("retire", uid=req.uid, tokens=n_tok)
 
         t_start = time.perf_counter()
         t_prefill = t_decode = 0.0
@@ -255,6 +274,20 @@ class ServeEngine:
                 if placed is None:
                     break
                 idx, req = placed
+                if tracer is not None:
+                    t_adm = time.perf_counter()
+                    arr = sched.arrival_wall.get(req.uid, t_adm)
+                    trk = f"req{req.uid}"
+                    rid = tracer.begin("request", req.trace_id, track=trk,
+                                       t0=arr, prompt_len=len(req.prompt),
+                                       max_new_tokens=req.max_new_tokens)
+                    root_span[req.uid] = rid
+                    tracer.add("queued", arr, t_adm, req.trace_id,
+                               parent=rid, track=trk)
+                    tracer.add("admit", t_adm, t_adm, req.trace_id,
+                               parent=rid, track=trk, slot=idx)
+                if recorder is not None:
+                    recorder.record("admit", uid=req.uid, slot=idx)
                 pb = self.bucket_for(len(req.prompt))
                 padded = np.full(pb, self.pad_fill, np.int32)
                 padded[:len(req.prompt)] = req.prompt
@@ -269,6 +302,14 @@ class ServeEngine:
                 prefill_calls += 1
                 first_wall[req.uid] = now
                 h_ttft.observe(now - sched.arrival_wall.get(req.uid, t0))
+                live.observe_ttft(
+                    now - sched.arrival_wall.get(req.uid, t0), now)
+                last_tok_wall[req.uid] = now
+                if tracer is not None:
+                    tracer.add("prefill", t0, now, req.trace_id,
+                               parent=root_span.get(req.uid),
+                               track=f"req{req.uid}", bucket=pb,
+                               prompt_len=len(req.prompt))
                 done = sched.record(idx, first, self.eos_id)
                 if done is not None:
                     retire(done, now)
@@ -291,10 +332,27 @@ class ServeEngine:
             t_decode += now - t0
             h_tick.observe(now - t0)
             decode_ticks += 1
+            live.sample(sched.queue_depth(tick), sched.occupancy, now)
+            if tracer is not None:
+                tracer.add("decode_tick", t0, now, "engine",
+                           track="engine", slots=sched.occupancy)
             for idx in sched.active_slots:
+                r = sched.slots[idx].request
+                lt = last_tok_wall.get(r.uid)
+                if lt is not None:
+                    live.observe_itl(now - lt, now)
+                last_tok_wall[r.uid] = now
+                if tracer is not None:
+                    tracer.add("decode", t0, now, r.trace_id,
+                               parent=root_span.get(r.uid),
+                               track=f"req{r.uid}")
                 done = sched.record(idx, int(out[idx]), self.eos_id)
                 if done is not None:
                     retire(done, now)
+            if telemetry is not None and now - last_window_emit >= 1.0:
+                last_window_emit = now
+                telemetry.writer.emit("obs_window", scope="serve",
+                                      **live.signals(now))
             tick += 1
 
         total = time.perf_counter() - t_start
@@ -327,6 +385,7 @@ class ServeEngine:
             "decode_compiles": self._decode.traces,
             "buckets": list(self.buckets),
             "latency": latency,
+            "window": live.signals(),
         }
         if telemetry is not None:
             telemetry.writer.emit("obs_serve", stats=stats)
@@ -560,15 +619,20 @@ class PagedEngine:
         if self.draft_layers is not None:
             self.draft_pools = self._draft_copy(self.draft_pools, s, d)
 
-    def _make_writable(self, idx: int, lo_pos: int, hi_pos: int) -> None:
+    def _make_writable(self, idx: int, lo_pos: int, hi_pos: int) -> int:
         """Run the manager's COW check over every logical block touched
         by positions ``[lo_pos, hi_pos]`` BEFORE computing scatter
-        targets (the check may swap table entries)."""
+        targets (the check may swap table entries).  Returns the number
+        of blocks actually copied (0 on the common no-COW path), so the
+        caller can attribute a COW span without timing the no-op case."""
+        copies = 0
         for lg in range(lo_pos // self.block_size,
                         hi_pos // self.block_size + 1):
             pair = self.manager.writable(idx, lg)
             if pair is not None:
                 self._cow(*pair)
+                copies += 1
+        return copies
 
     def _validate(self, req: Request) -> None:
         if len(req.prompt) + req.max_new_tokens > self.max_len:
@@ -648,6 +712,43 @@ class PagedEngine:
         decode_ticks = occupancy_sum = 0
         t_prefill = t_decode = 0.0
 
+        tracer = getattr(telemetry, "tracer", None) \
+            if telemetry is not None else None
+        recorder = getattr(telemetry, "recorder", None) \
+            if telemetry is not None else None
+        live = LiveSignals()
+        root_span: dict[int, int] = {}       # uid -> open request span
+        last_tok_wall: dict[int, float] = {}  # uid -> last emit wall
+        last_window_emit = -float("inf")
+        slo_tripped = False
+        if recorder is not None:
+            # block-manager events (evictions, COW detaches) go straight
+            # into the black box; cleared before run() returns because
+            # the manager outlives the run
+            mgr.on_event = (lambda kind, **f:
+                            recorder.record("kv_" + kind, **f))
+
+        def check_slo(req, now):
+            """Compare measured latencies against the request's SLOs;
+            breaches land in the flight recorder and the FIRST breach
+            trips a dump (the black box for "why did we fall off SLO")."""
+            nonlocal slo_tripped
+            breaches = []
+            t = ttft_s.get(req.uid)
+            if req.slo_ttft_ms is not None and t is not None \
+                    and t * 1e3 > req.slo_ttft_ms:
+                breaches.append(("ttft", t * 1e3, req.slo_ttft_ms))
+            e = e2e_s.get(req.uid)
+            if req.slo_e2e_ms is not None and e is not None \
+                    and e * 1e3 > req.slo_e2e_ms:
+                breaches.append(("e2e", e * 1e3, req.slo_e2e_ms))
+            for which, ms, slo in breaches:
+                recorder.record("slo_breach", uid=req.uid, which=which,
+                                measured_ms=ms, slo_ms=slo)
+            if breaches and not slo_tripped:
+                slo_tripped = True
+                recorder.trip("slo_breach")
+
         def retire(req, idx, now):
             mgr.release(idx)
             for d in (stream, committed, plans, pendtok):
@@ -659,15 +760,45 @@ class PagedEngine:
             fw = first_wall.pop(req.uid, None)
             if fw is not None and n_tok > 1:
                 h_itl.observe((now - fw) / (n_tok - 1))
+            last_tok_wall.pop(req.uid, None)
+            if tracer is not None:
+                rid = root_span.pop(req.uid, None)
+                tracer.add("retire", now, now, req.trace_id, parent=rid,
+                           track=f"req{req.uid}", tokens=n_tok, slot=idx)
+                if rid is not None:
+                    tracer.end(rid, t1=now)
+            if recorder is not None:
+                recorder.record("retire", uid=req.uid, slot=idx,
+                                tokens=n_tok)
+                check_slo(req, now)
 
         def emit(idx, token, now):
             """Record one generated token; True when the slot retired
             (EOS or budget — same truncation rules as v1/generate)."""
+            uid = sched.slots[idx].request.uid
+            lt = last_tok_wall.get(uid)
+            if lt is not None:
+                live.observe_itl(now - lt, now)
+            last_tok_wall[uid] = now
             done = sched.record(idx, token, self.eos_id)
             if done is not None:
                 retire(done, idx, now)
                 return True
             return False
+
+        def make_writable(idx, lo, hi):
+            """COW check with span attribution: the no-copy common case
+            costs one extra clock read only when tracing is on."""
+            if tracer is None:
+                self._make_writable(idx, lo, hi)
+                return
+            t0 = time.perf_counter()
+            n = self._make_writable(idx, lo, hi)
+            if n:
+                req = sched.slots[idx].request
+                tracer.add("cow", t0, time.perf_counter(), req.trace_id,
+                           parent=root_span.get(req.uid),
+                           track=f"req{req.uid}", copies=n)
 
         def run_chunk(idx, ev):
             nonlocal chunk_calls, t_prefill
@@ -676,7 +807,8 @@ class PagedEngine:
             L = len(req.prompt)
             toks = chunk_tokens(stream[idx], plan, self.chunk,
                                 self.pad_fill)
-            self._make_writable(idx, committed[idx], plan.commit_to - 1)
+            rid = root_span.get(req.uid)
+            make_writable(idx, committed[idx], plan.commit_to - 1)
             wb, wo, _ = write_targets(plan.feed_start, self.chunk,
                                       committed[idx], L,
                                       mgr.tables[idx], bs)
@@ -708,11 +840,23 @@ class PagedEngine:
                 ttft_s[req.uid] = now - sched.arrival_wall.get(req.uid,
                                                                now)
                 h_ttft.observe(ttft_s[req.uid])
+                live.observe_ttft(ttft_s[req.uid], now)
+                if tracer is not None:
+                    tracer.add("prefill_chunk", t0, now, req.trace_id,
+                               parent=rid, track=f"req{req.uid}",
+                               feed_start=plan.feed_start,
+                               commit_to=plan.commit_to, is_last=True)
                 stream[idx].append(first)
                 emit(idx, first, now)
             else:
                 jax.block_until_ready(self.pools)
-                t_prefill += time.perf_counter() - t0
+                t1 = time.perf_counter()
+                t_prefill += t1 - t0
+                if tracer is not None:
+                    tracer.add("prefill_chunk", t0, t1, req.trace_id,
+                               parent=rid, track=f"req{req.uid}",
+                               feed_start=plan.feed_start,
+                               commit_to=plan.commit_to, is_last=False)
 
         t_start = time.perf_counter()
         tick = 0
@@ -728,6 +872,7 @@ class PagedEngine:
                 head = sched.peek(tick)
                 if head is None:
                     break
+                t_adm = time.perf_counter()
                 sp = mgr.match_prefix(head.prompt)
                 if not mgr.can_admit(sp, self._capacity_len(head)):
                     break              # wait for retirements to free KV
@@ -742,6 +887,25 @@ class PagedEngine:
                 prompt_tokens += L
                 if ev is not None:
                     ev["placed"].append(req.uid)
+                if tracer is not None:
+                    noww = time.perf_counter()
+                    arr = sched.arrival_wall.get(req.uid, noww)
+                    trk = f"req{req.uid}"
+                    rid = tracer.begin("request", req.trace_id,
+                                       track=trk, t0=arr, prompt_len=L,
+                                       max_new_tokens=req.max_new_tokens)
+                    root_span[req.uid] = rid
+                    tracer.add("queued", arr, t_adm, req.trace_id,
+                               parent=rid, track=trk)
+                    aid = tracer.add("admit", t_adm, noww, req.trace_id,
+                                     parent=rid, track=trk, slot=idx,
+                                     shared_len=shared)
+                    tracer.add("prefix_match", t_adm, noww, req.trace_id,
+                               parent=aid, track=trk, shared_len=shared,
+                               hit=shared > 0)
+                if recorder is not None:
+                    recorder.record("admit", uid=req.uid, slot=idx,
+                                    shared_len=shared)
 
             if not sched.occupancy:
                 nxt = sched.next_arrival()
@@ -777,7 +941,7 @@ class PagedEngine:
                     wo = np.zeros(self.max_slots, np.int32)
                     for i in dec:
                         c = committed[i]
-                        self._make_writable(i, c, c)
+                        make_writable(i, c, c)
                         toks[i] = pendtok[i]
                         pos[i] = c
                         wb[i] = mgr.tables[i, c // bs]
@@ -793,15 +957,22 @@ class PagedEngine:
                     t_decode += now - t0
                     h_tick.observe(now - t0)
                     decode_ticks += 1
+                    if tracer is not None:
+                        tracer.add("decode_tick", t0, now, "engine",
+                                   track="engine", slots=len(dec))
                     for i in dec:
                         tok = int(out[i])
                         committed[i] += 1
                         stream[i].append(tok)
                         mgr.register_committed(i, stream[i], committed[i])
                         pendtok[i] = tok
+                        r = sched.slots[i].request
                         if ev is not None:
-                            ev["decoded"].append(
-                                sched.slots[i].request.uid)
+                            ev["decoded"].append(r.uid)
+                        if tracer is not None:
+                            tracer.add("decode", t0, now, r.trace_id,
+                                       parent=root_span.get(r.uid),
+                                       track=f"req{r.uid}")
                         emit(i, tok, now)
                 else:
                     k = self.spec_k
@@ -813,7 +984,7 @@ class PagedEngine:
                     wo = np.zeros((self.max_slots, T), np.int32)
                     for i in dec:
                         c = committed[i]
-                        self._make_writable(i, c, c + k)
+                        make_writable(i, c, c + k)
                         toks[i] = pendtok[i]
                         pos[i] = c
                         pp = np.arange(c, c + T)
@@ -838,6 +1009,10 @@ class PagedEngine:
                     h_tick.observe(now - t0)
                     decode_ticks += 1
                     spec_rounds += len(dec)
+                    if tracer is not None:
+                        tracer.add("decode_tick", t0, now, "engine",
+                                   track="engine", slots=len(dec),
+                                   speculative=True)
                     for i in dec:
                         a, emitted = spec_mod.greedy_accept(props[i],
                                                             g[i])
@@ -845,9 +1020,13 @@ class PagedEngine:
                         accepted_total += a
                         h_accept.observe(a / k if k else 0.0)
                         committed[i] += a + 1
+                        r = sched.slots[i].request
                         if ev is not None:
-                            ev["decoded"].append(
-                                sched.slots[i].request.uid)
+                            ev["decoded"].append(r.uid)
+                        if tracer is not None:
+                            tracer.add("decode", t0, now, r.trace_id,
+                                       parent=root_span.get(r.uid),
+                                       track=f"req{r.uid}", accepted=a)
                         retired = False
                         for tok in emitted:
                             stream[i].append(tok)
@@ -858,6 +1037,12 @@ class PagedEngine:
                             pendtok[i] = emitted[-1]
                             mgr.register_committed(i, stream[i],
                                                    committed[i])
+            noww = time.perf_counter()
+            live.sample(sched.queue_depth(tick), sched.occupancy, noww)
+            if telemetry is not None and noww - last_window_emit >= 1.0:
+                last_window_emit = noww
+                telemetry.writer.emit("obs_window", scope="serve",
+                                      **live.signals(noww))
             if ev is not None:
                 timeline.append(ev)
             tick += 1
@@ -921,7 +1106,10 @@ class PagedEngine:
             "spec": spec_stats,
             "slo": slo_report(accepted, ttft_s, e2e_s),
             "latency": latency,
+            "window": live.signals(),
         }
+        if recorder is not None:
+            mgr.on_event = None
         if telemetry is not None:
             telemetry.writer.emit("obs_serve", stats=stats)
         out = {"results": sched.finished, "errors": errors, "stats": stats}

@@ -260,6 +260,10 @@ class BlockManager:
         self.copies = 0
         self.evictions = 0
         self.peak_in_use = 0
+        # optional observability hook: ``on_event(kind, **fields)`` fires
+        # on evictions and COW detaches (the engine wires it to the
+        # tracer/flight recorder; None costs nothing)
+        self.on_event = None
 
     # --- accounting -------------------------------------------------------
     @property
@@ -299,6 +303,8 @@ class BlockManager:
             self._deref(b)
             self.evictions += 1
             freed += 1
+        if freed and self.on_event is not None:
+            self.on_event("evict", freed=freed, need=need)
         return freed
 
     # --- prefix matching --------------------------------------------------
@@ -435,6 +441,9 @@ class BlockManager:
         self.tables[slot, logical] = dst
         self._deref(b)
         self.copies += 1
+        if self.on_event is not None:
+            self.on_event("cow", slot=slot, logical=logical,
+                          src=b, dst=dst)
         return b, dst
 
     # --- registration -----------------------------------------------------

@@ -54,6 +54,11 @@ class Request:
                 raise ValueError(f"request {self.uid}: {name} must be "
                                  f"positive, got {v}")
 
+    @property
+    def trace_id(self) -> str:
+        """Stable per-request trace id (obs.trace span correlation)."""
+        return f"req-{self.uid}"
+
 
 @dataclasses.dataclass
 class _Slot:

@@ -297,6 +297,13 @@ def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
             if totals.get("anomaly"):
                 telemetry.registry.counter("sentinel_anomalies").inc(
                     float(totals["anomaly"]))
+                rec = getattr(telemetry, "recorder", None)
+                if rec is not None:
+                    rec.record("sentinel_anomaly", epoch=epoch,
+                               count=int(totals["anomaly"]),
+                               policy=sentinel.policy
+                               if sentinel is not None else None)
+                    rec.trip("sentinel_anomaly")
             gp = telemetry.phase_rollup(f"train_epoch_{epoch}",
                                         since=phase_mark)
             telemetry.note_train(gp["steps"], gp["wall_seconds"],

@@ -73,11 +73,16 @@ class ChaosPlan:
     ``fired`` records every event that actually triggered as
     ``(global_step, kind)`` — the drill's evidence that the fault really
     happened (a chaos test that silently injects nothing proves
-    nothing)."""
+    nothing).
 
-    def __init__(self, events, seed: int = 0):
+    ``recorder`` (:class:`..obs.recorder.FlightRecorder`, optional) gets
+    a ``chaos_fired`` event for every injection, so a black-box dump
+    shows the fault alongside the anomaly it caused."""
+
+    def __init__(self, events, seed: int = 0, recorder=None):
         self.events = sorted(events, key=lambda e: e.step)
         self.seed = int(seed)
+        self.recorder = recorder
         self.fired: list[tuple[int, str]] = []
         self._done: set[int] = set()  # indices of one-shot events consumed
 
@@ -113,6 +118,9 @@ class ChaosPlan:
                 continue
             self._done.add(i)
             self.fired.append((global_step, ev.kind))
+            if self.recorder is not None:
+                self.recorder.record("chaos_fired", step=global_step,
+                                     fault=ev.kind)
             if ev.kind == "nan_batch":
                 x = self._poison(x, ev, np.nan)
             elif ev.kind == "grad_spike":
@@ -392,3 +400,76 @@ def run_resilience_drill(seed: int = 0) -> dict:
     record["recovered_bit_identical"] = bool(parity)
     record["faults_fired"] += list(plan.fired)
     return record
+
+
+def run_blackbox_drill(seed: int = 0,
+                       dump_path: str | None = None) -> dict:
+    """Seeded chaos → deterministic flight-recorder dump (ISSUE 11).
+
+    Runs the sentinel section of the resilience drill with a
+    :class:`..obs.recorder.FlightRecorder` in sequence-only mode
+    (``clock=None``) wired into both the chaos plan and the train loop:
+    the injected ``nan_batch`` fires, the sentinel contains it, and the
+    containment TRIPS the recorder — producing a black-box dump whose
+    bytes are BIT-IDENTICAL across repeated runs of the same seed (the
+    post-mortem analog of the containment bit-identity the resilience
+    drill asserts).  Returns the dump path, its sha256, and what fired.
+    """
+    import hashlib
+    import json
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_deep_learning_tpu.data.datasets import synthetic_mqtt
+    from distributed_deep_learning_tpu.data.loader import make_loaders
+    from distributed_deep_learning_tpu.data.splits import train_val_test_split
+    from distributed_deep_learning_tpu.models.mlp import MLP
+    from distributed_deep_learning_tpu.obs import RunTelemetry
+    from distributed_deep_learning_tpu.obs.recorder import FlightRecorder
+    from distributed_deep_learning_tpu.runtime.mesh import build_mesh
+    from distributed_deep_learning_tpu.train.loop import fit
+    from distributed_deep_learning_tpu.train.objectives import (
+        cross_entropy_loss)
+    from distributed_deep_learning_tpu.train.sentinel import (SentinelConfig,
+                                                              attach_sentinel)
+    from distributed_deep_learning_tpu.train.state import create_train_state
+    from distributed_deep_learning_tpu.train.step import (make_step_fns,
+                                                          place_state)
+
+    mesh = build_mesh({"data": 1}, jax.devices()[:1])
+    ds = synthetic_mqtt(1024, seed=21)
+    splits = train_val_test_split(len(ds), seed=42)
+    loaders = make_loaders(ds, splits, 64, mesh)
+    model = MLP(hidden_size=16)
+    cfg = SentinelConfig(policy="skip", warmup_steps=2)
+    state = place_state(attach_sentinel(create_train_state(
+        model, jax.random.key(7), jnp.zeros((1, 48)), optax.sgd(0.05))),
+        mesh)
+    sent_step, eval_step = make_step_fns(mesh, cross_entropy_loss,
+                                         sentinel=cfg)
+
+    if dump_path is None:
+        dump_path = os.path.join(tempfile.mkdtemp(prefix="blackbox_"),
+                                 "blackbox.json")
+    rec = FlightRecorder(clock=None)   # seq-only: deterministic bytes
+    rec.arm(dump_path)
+    plan = ChaosPlan([ChaosEvent(step=5, kind="nan_batch")], seed=seed,
+                     recorder=rec)
+    telemetry = RunTelemetry(path=None, recorder=rec)
+    fit(state, sent_step, eval_step, *loaders, epochs=1, sentinel=cfg,
+        chaos=plan, telemetry=telemetry)
+    telemetry.close()
+
+    with open(dump_path, "rb") as f:
+        raw = f.read()
+    doc = json.loads(raw)
+    return {
+        "dump_path": dump_path,
+        "dump_sha256": hashlib.sha256(raw).hexdigest(),
+        "trips": doc["trips"],
+        "events_captured": doc["captured"],
+        "faults_fired": list(plan.fired),
+    }

@@ -203,6 +203,16 @@ class Config:
     obs_file: str | None = None         # telemetry sidecar path (default
                                         #   obs_events.jsonl; non-rank-0
                                         #   processes get .rankN suffix)
+    obs_trace: str | None = None        # span-trace export path (Chrome/
+                                        #   Perfetto JSON; implies the
+                                        #   per-step/request Tracer)
+    obs_rotate_mb: float | None = None  # size-cap the JSONL sidecar:
+                                        #   rotate at N MB, fsync on
+                                        #   rollover (obs/export.py)
+    obs_blackbox: str | None = None     # arm a crash flight recorder:
+                                        #   bounded event ring dumped
+                                        #   here on sentinel trip /
+                                        #   fatal signal / exit
     sentinel: str = "off"               # anomaly sentinel policy:
                                         #   off|skip|rollback|halt
                                         #   (train/sentinel.py)
@@ -469,6 +479,21 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
     p.add_argument("--obs-file", type=str, default=None, metavar="PATH",
                    help="telemetry event-stream path (default "
                         "obs_events.jsonl; requires --obs)")
+    p.add_argument("--obs-trace", type=str, default=None, metavar="PATH",
+                   help="also record per-step causal spans and export "
+                        "them here as Chrome/Perfetto trace JSON "
+                        "(load in ui.perfetto.dev; requires --obs)")
+    p.add_argument("--obs-rotate-mb", type=float, default=None,
+                   metavar="MB",
+                   help="size-cap the telemetry stream: rotate the "
+                        "JSONL sidecar at this many MB, fsyncing each "
+                        "closed segment (requires --obs)")
+    p.add_argument("--obs-blackbox", type=str, default=None,
+                   metavar="PATH",
+                   help="arm a crash flight recorder: keep a bounded "
+                        "in-memory ring of recent events and dump it "
+                        "here on sentinel anomaly, SLO breach, fatal "
+                        "signal or process exit (requires --obs)")
     p.add_argument("--pipeline-schedule",
                    choices=["gpipe", "1f1b", "interleaved"],
                    default="gpipe",
@@ -672,6 +697,15 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
     if args.obs_file and not args.obs:
         raise SystemExit("--obs-file requires --obs (the path names the "
                          "telemetry stream --obs records)")
+    for flag, val in (("--obs-trace", args.obs_trace),
+                      ("--obs-rotate-mb", args.obs_rotate_mb),
+                      ("--obs-blackbox", args.obs_blackbox)):
+        if val and not args.obs:
+            raise SystemExit(f"{flag} requires --obs (it extends the "
+                             "telemetry --obs turns on)")
+    if args.obs_rotate_mb is not None and args.obs_rotate_mb <= 0:
+        raise SystemExit(f"--obs-rotate-mb {args.obs_rotate_mb}: must "
+                         "be > 0")
     if args.max_slots <= 0:
         raise SystemExit(f"--max-slots {args.max_slots}: must be >= 1 "
                          "(the engine's static batch dimension)")
@@ -751,6 +785,9 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         metrics_file=args.metrics_file,
         obs=args.obs,
         obs_file=args.obs_file,
+        obs_trace=args.obs_trace,
+        obs_rotate_mb=args.obs_rotate_mb,
+        obs_blackbox=args.obs_blackbox,
         sentinel=args.sentinel,
         sentinel_window=args.sentinel_window,
         sentinel_factor=args.sentinel_factor,

@@ -756,12 +756,28 @@ def _maybe_telemetry(config: Config):
     ``obs.metrics.merge_snapshots``."""
     if not config.obs:
         return None
-    from distributed_deep_learning_tpu.obs import RunTelemetry
+    from distributed_deep_learning_tpu.obs import (FlightRecorder,
+                                                   RunTelemetry)
 
-    path = config.obs_file or "obs_events.jsonl"
-    if not is_coordinator():
-        path = f"{path}.rank{config.distributed.process_id}"
-    return RunTelemetry(path)
+    def _rank(p: str | None) -> str | None:
+        if p is None or is_coordinator():
+            return p
+        return f"{p}.rank{config.distributed.process_id}"
+
+    recorder = None
+    if config.obs_blackbox:
+        # real-clocked outside drills (utils/chaos.py owns the
+        # clock=None deterministic mode); install() registers the
+        # atexit + SIGTERM dump hooks so preemption leaves a black box
+        import time as _time
+
+        recorder = FlightRecorder(clock=_time.perf_counter)
+        recorder.install(path=_rank(config.obs_blackbox))
+    return RunTelemetry(_rank(config.obs_file or "obs_events.jsonl"),
+                        trace_path=_rank(config.obs_trace),
+                        recorder=recorder,
+                        rotate_mb=config.obs_rotate_mb,
+                        fsync_on_rollover=config.obs_rotate_mb is not None)
 
 
 def _log_obs_summary(logger, summary: dict) -> None:

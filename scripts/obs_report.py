@@ -9,10 +9,23 @@ users saw.
     python scripts/obs_report.py obs_events.jsonl
     python scripts/obs_report.py obs_events.jsonl --phases   # per-phase too
     python scripts/obs_report.py obs_events.jsonl --prom     # Prometheus text
+    python scripts/obs_report.py obs_events.jsonl --trace    # span trace
+    python scripts/obs_report.py obs_events.jsonl --window   # live windows
 
 ``--prom`` dumps the final metrics snapshot in Prometheus text
 exposition format (for a textfile collector or diffing against a scrape
 endpoint) instead of the report.
+
+``--trace`` summarises the Chrome/Perfetto span trace a
+``trace_path`` run exported (per-request causal chains: queued wait,
+prefill chunks, decode count, prefix hits) — the trace file itself
+loads in Perfetto / chrome://tracing for the zoomable view.  The path
+is taken from the stream's ``obs_trace`` event; pass
+``--trace PATH`` to point at a trace file directly.
+
+``--window`` prints the rolling-window live signals (``obs_window``
+events): windowed TTFT/ITL percentiles, queue depth, slot occupancy
+and request/token rates over the run.
 """
 
 from __future__ import annotations
@@ -69,6 +82,86 @@ def _comm_block(snapshot: dict) -> list[str]:
     if frac is not None:
         lines.append(f"  overlap fraction {_fmt_frac(frac)}")
     return lines
+
+
+def _span_ms(spans: list[dict], name: str) -> tuple[int, float]:
+    """(count, summed duration ms) of the named spans."""
+    picked = [s for s in spans if s["name"] == name]
+    return len(picked), sum(s.get("dur", 0) for s in picked) / 1e3
+
+
+def render_trace(spans: list[dict], limit: int = 40) -> str:
+    """Per-request causal-chain summary of a ``ph:"X"`` span list
+    (:func:`obs.trace.read_chrome_trace`).  One line per request trace,
+    ordered by root-span start; non-request tracks (train, engine)
+    roll up as name -> count/total."""
+    from collections import defaultdict
+
+    by_trace: dict[str, list[dict]] = defaultdict(list)
+    for s in spans:
+        by_trace[s.get("cat", "?")].append(s)
+
+    reqs, other = [], []
+    for tid, ss in by_trace.items():
+        root = next((s for s in ss if s["name"] == "request"), None)
+        (reqs if root is not None else other).append((tid, ss, root))
+    reqs.sort(key=lambda r: r[2]["ts"])
+
+    out = [f"== span trace ({len(spans)} spans, "
+           f"{len(reqs)} request traces) =="]
+    for tid, ss, root in reqs[:limit]:
+        _, q_ms = _span_ms(ss, "queued")
+        n_chunk, pf_ms = _span_ms(ss, "prefill_chunk")
+        if not n_chunk:                       # v1 engine: single prefill
+            n_chunk, pf_ms = _span_ms(ss, "prefill")
+        n_dec, _ = _span_ms(ss, "decode")
+        pm = next((s for s in ss if s["name"] == "prefix_match"), None)
+        hit = ""
+        if pm is not None and pm["args"].get("hit"):
+            hit = f"  prefix-hit shared={pm['args'].get('shared_len')}"
+        cow_n, _ = _span_ms(ss, "cow")
+        cow = f"  cow x{cow_n}" if cow_n else ""
+        out.append(f"  {tid:<8} e2e {root.get('dur', 0) / 1e3:9.1f}ms  "
+                   f"queued {q_ms:8.1f}ms  "
+                   f"prefill x{n_chunk} {pf_ms:8.1f}ms  "
+                   f"decode x{n_dec}{hit}{cow}")
+    if len(reqs) > limit:
+        out.append(f"  ... {len(reqs) - limit} more request traces")
+    for tid, ss, _ in sorted(other):
+        out.append(f"  [{tid}]")
+        names = sorted({s["name"] for s in ss})
+        for name in names:
+            n, ms = _span_ms(ss, name)
+            out.append(f"    {name:<16} x{n:<5} {ms:10.1f}ms")
+    return "\n".join(out)
+
+
+def render_window(events: list[dict]) -> str:
+    """The rolling-window live signals over the run, one line per
+    ``obs_window`` emit (engines emit at most one per second)."""
+    wins = [e for e in events if e.get("event") == "obs_window"]
+    if not wins:
+        return ("no obs_window events (windows are emitted by serve "
+                "engine runs with --obs)")
+    t0 = wins[0].get("t", 0.0)
+    out = [f"== live windows ({wins[0].get('window_s')}s rolling, "
+           f"{len(wins)} samples) ==",
+           "  t+s     ttft p50/p99 ms     itl p50/p99 ms   "
+           "qdepth p50/max  occ   req/s   tok/s"]
+    for w in wins:
+        def ms(key):
+            v = w.get(key)
+            return f"{1e3 * v:8.1f}" if v is not None else "     n/a"
+        out.append(
+            f"  {w.get('t', 0.0) - t0:6.1f}"
+            f"{ms('ttft_p50_s')}/{ms('ttft_p99_s')}"
+            f"{ms('itl_p50_s')}/{ms('itl_p99_s')}"
+            f"   {w.get('queue_depth_p50', 0):5.0f}/"
+            f"{w.get('queue_depth_max', 0):<4.0f}"
+            f"{w.get('occupancy_last', 0.0):6.1f}"
+            f"{w.get('request_rate_per_s', 0.0):8.2f}"
+            f"{w.get('token_rate_per_s', 0.0):8.1f}")
+    return "\n".join(out)
 
 
 def render(events: list[dict], phases: bool = False) -> str:
@@ -150,12 +243,42 @@ def main(argv=None) -> int:
     p.add_argument("--prom", action="store_true",
                    help="dump the final metrics snapshot as Prometheus "
                         "text exposition instead of the report")
+    p.add_argument("--trace", nargs="?", const="", default=None,
+                   metavar="PATH",
+                   help="summarise the exported span trace instead of "
+                        "the report (path defaults to the stream's "
+                        "obs_trace event)")
+    p.add_argument("--window", action="store_true",
+                   help="print the rolling-window live signals "
+                        "(obs_window events) instead of the report")
     args = p.parse_args(argv)
 
     from distributed_deep_learning_tpu.obs.export import (prometheus_text,
                                                           read_events)
 
     events = list(read_events(args.stream))
+    if args.trace is not None:
+        from distributed_deep_learning_tpu.obs.trace import \
+            read_chrome_trace
+
+        path = args.trace
+        if not path:
+            recs = [e for e in events if e.get("event") == "obs_trace"]
+            if not recs:
+                print("no obs_trace event in the stream (run with a "
+                      "trace path, or pass --trace PATH)",
+                      file=sys.stderr)
+                return 1
+            path = recs[-1]["path"]
+            if not os.path.isabs(path):
+                # The producer recorded the path relative to its own cwd;
+                # resolve against the stream it sits next to.
+                path = os.path.join(os.path.dirname(os.path.abspath(args.stream)), path)
+        print(render_trace(read_chrome_trace(path)))
+        return 0
+    if args.window:
+        print(render_window(events))
+        return 0
     if args.prom:
         snaps = [e for e in events if e.get("event") == "obs_snapshot"]
         if not snaps:

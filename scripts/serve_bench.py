@@ -110,7 +110,26 @@ def main(argv=None) -> int:
     p.add_argument("--vocab", type=int, default=None)
     p.add_argument("--max-len", type=int, default=None)
     p.add_argument("--out", default=None, help="also write the JSON here")
+    # --- observability (ISSUE 11) ---
+    p.add_argument("--obs", action="store_true",
+                   help="attach run telemetry: JSONL event stream "
+                        "(--obs-file) + per-request span trace exported "
+                        "as Chrome/Perfetto JSON (--obs-trace)")
+    p.add_argument("--obs-file", default="obs_events.jsonl",
+                   help="telemetry event stream path (with --obs)")
+    p.add_argument("--obs-trace", default=None, metavar="PATH",
+                   help="span-trace output path (default "
+                        "serve_trace.json with --obs; giving a path "
+                        "implies --obs)")
     args = p.parse_args(argv)
+
+    telemetry = None
+    if args.obs or args.obs_trace:
+        from distributed_deep_learning_tpu.obs import RunTelemetry
+
+        telemetry = RunTelemetry(
+            path=args.obs_file,
+            trace_path=args.obs_trace or "serve_trace.json")
 
     model_kw = {k: v for k, v in (
         ("num_layers", args.layers), ("d_model", args.d_model),
@@ -153,7 +172,7 @@ def main(argv=None) -> int:
                 kv_block_size=args.kv_block_size,
                 prefill_chunk=args.prefill_chunk,
                 draft_layers=args.draft or None, spec_k=args.spec_k,
-                compare_engine=not args.skip_v1)
+                compare_engine=not args.skip_v1, telemetry=telemetry)
         except ValueError as e:
             p.error(f"{e} — shrink the trace (--prompt-max / --new-max "
                     f"/ --shared-prefix-len) or raise --max-len")
@@ -176,8 +195,17 @@ def main(argv=None) -> int:
             new_tokens=(4 if args.new_min is None else args.new_min,
                         64 if args.new_max is None else args.new_max),
             max_slots=args.max_slots, prefill_buckets=buckets,
-            stagger=args.stagger, skip_naive=args.skip_naive)
+            stagger=args.stagger, skip_naive=args.skip_naive,
+            telemetry=telemetry)
         _latency_line("engine", record["engine"].get("latency") or {})
+
+    if telemetry is not None:
+        summary = telemetry.close()
+        tr = summary.get("trace")
+        if tr:
+            print(f"obs: {tr['spans']} spans -> {tr['path']} "
+                  f"(load in Perfetto / chrome://tracing); "
+                  f"events -> {args.obs_file}", file=sys.stderr)
 
     out = json.dumps(record)
     print(out)

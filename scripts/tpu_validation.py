@@ -473,12 +473,20 @@ def observability(steps_hint=10):
     records.  On TPU the MFU field is live (the chip is in the peak
     table); on CPU smoke it exercises the same path via
     ``DDL_OBS_PEAK_FLOPS``.  Also runs the instrumentation-overhead A/B
-    (the <2% acceptance bar) on this box."""
+    (the <2% acceptance bar) on this box.
+
+    Generation 2 (ISSUE 11): the run also exports the per-step span
+    trace (``--obs-trace``) so the harvest proves the Perfetto export
+    path on real hardware (span count + dropped count from the
+    ``obs_trace`` event), and the tracing-overhead A/B
+    (:func:`obs.bench.trace_overhead_bench`, its own <2% bar) runs
+    beside the gen-1 one."""
     import tempfile
 
     import jax
 
-    from distributed_deep_learning_tpu.obs.bench import overhead_bench
+    from distributed_deep_learning_tpu.obs.bench import (
+        overhead_bench, trace_overhead_bench)
     from distributed_deep_learning_tpu.obs.export import read_events
     from distributed_deep_learning_tpu.utils.config import parse_args
     from distributed_deep_learning_tpu.workloads import (get_spec,
@@ -489,16 +497,18 @@ def observability(steps_hint=10):
     if not on_tpu:
         # exercise the full MFU path on the smoke box (arbitrary peak)
         os.environ.setdefault("DDL_OBS_PEAK_FLOPS", "1e12")
-    stream = os.path.join(tempfile.mkdtemp(prefix="obs_val_"),
-                          "obs_events.jsonl")
+    tmpdir = tempfile.mkdtemp(prefix="obs_val_")
+    stream = os.path.join(tmpdir, "obs_events.jsonl")
+    trace = os.path.join(tmpdir, "trace.json")
     argv = ["-e", "2", "-b", "64" if on_tpu else "32", "-m", "data",
-            "--obs", "--obs-file", stream]
+            "--obs", "--obs-file", stream, "--obs-trace", trace]
     run_workload(get_spec("mlp"), parse_args(argv, workload="mlp"))
 
     events = list(read_events(stream))
     run_gp = next((e for e in events if e.get("event") == "obs_goodput"
                    and e.get("scope") == "run"), {})
     mfu = next((e for e in events if e.get("event") == "obs_mfu"), {})
+    tr = next((e for e in events if e.get("event") == "obs_trace"), {})
     return {
         "section": "observability", "on_tpu": on_tpu,
         "goodput_fractions": run_gp.get("fractions"),
@@ -508,7 +518,11 @@ def observability(steps_hint=10):
         "steps_per_sec": mfu.get("steps_per_sec"),
         "step_flops": mfu.get("step_flops"),
         "device_kind": mfu.get("device_kind"),
+        "trace_spans": tr.get("spans"),
+        "trace_dropped": tr.get("dropped"),
         "overhead": overhead_bench(
+            steps=48, repeats=5 if on_tpu else 3),
+        "trace_overhead": trace_overhead_bench(
             steps=48, repeats=5 if on_tpu else 3),
     }
 
