@@ -438,6 +438,10 @@ def _serving() -> dict | None:
     out["slo_attainment"] = pe["slo_attainment"]
     out["spec_acceptance"] = round(pe["spec_acceptance"], 4) \
         if pe["spec_acceptance"] is not None else None
+    # exact KV footprints (allocated cache pytree bytes, ISSUE 12) — the
+    # denominators of every future "HBM saved per slot" claim
+    out["kv_cache_bytes"] = rec["engine"]["kv_cache_bytes"]
+    out["paged"]["kv_cache_bytes"] = pe["kv_cache_bytes"]
     return out
 
 
@@ -497,6 +501,42 @@ def _autotune() -> dict | None:
         "n_infeasible": result.n_infeasible,
         "rungs": result.rungs,
         "search_seconds": round(result.search_seconds, 2),
+    }
+
+
+def _memory_model() -> dict | None:
+    """Memory-model calibration (ISSUE 12): compile the MLP workload's
+    real train step at each remat corner of the lattice, read XLA's
+    measured temp bytes, fit ``ACT_FRACTION``/``RECOMPUTE_COST``, and
+    report predicted-vs-measured error for BOTH the analytic tables and
+    the fitted constants — CPU-measurable (``memory_analysis()`` reports
+    argument/temp bytes on the CPU backend too).  The calibrated mean
+    error is tracked under ``{platform}:mem_model_error_v1`` with an
+    absolute 25% ceiling; the uncalibrated analytic error rides in the
+    record as the before/after evidence."""
+    from distributed_deep_learning_tpu.tune.calibrate import run_calibration
+    from distributed_deep_learning_tpu.utils.config import parse_args
+    from distributed_deep_learning_tpu.workloads import get_spec
+
+    batch = int(os.environ.get("BENCH_MEMORY_BATCH", 32))
+    steps = int(os.environ.get("BENCH_MEMORY_STEPS", 2))
+    spec = get_spec("mlp")
+    config = parse_args(["-e", "1", "-b", str(batch), "-m", "data"],
+                        workload="mlp")
+    record = run_calibration(spec, config, steps=steps)
+    errors = record["errors"]
+    analytic, calibrated = errors["analytic"], errors["calibrated"]
+    return {
+        "metric": "analytic HBM model error vs XLA measured bytes "
+                  "(mlp, remat/ZeRO corners)",
+        "workload": "mlp",
+        "calibration_key": record["key"],
+        "constants": record["constants"],
+        "corners_measured": calibrated["corners"] if calibrated else 0,
+        "analytic_error_mean": analytic["mean"] if analytic else None,
+        "analytic_error_max": analytic["max"] if analytic else None,
+        "calibrated_error_mean": calibrated["mean"] if calibrated else None,
+        "calibrated_error_max": calibrated["max"] if calibrated else None,
     }
 
 
@@ -743,6 +783,11 @@ REGRESSION_BANDS: dict[str, tuple[str, float]] = {
     "comm_overlap_fraction_v1": ("higher", 0.40),
     "obs_overhead_fraction_v1": ("lower_abs", 0.025),
     "obs_trace_overhead_fraction_v1": ("lower_abs", 0.025),
+    # predicted-vs-measured HBM model error after calibration (ISSUE 12):
+    # the acceptance bar is <= 25% mean relative error on the calibrated
+    # corners; a ratio against a near-zero baseline would be meaningless,
+    # so the bar itself is the gate
+    "mem_model_error_v1": ("lower_abs", 0.25),
 }
 
 
@@ -1117,6 +1162,26 @@ def main() -> int:
             print(f"bench: observability section failed "
                   f"({type(exc).__name__}: {exc})", file=sys.stderr)
 
+    # --- memory model: calibrated vs analytic HBM prediction error ---------
+    memory_model = None
+    t_mem = 90 if on_tpu else 60
+    if os.environ.get("BENCH_MEMORY", "1") != "0" and _time_left() < t_mem:
+        print(f"bench: shedding memory-model section ({_time_left():.0f}s "
+              "left)", file=sys.stderr)
+    elif os.environ.get("BENCH_MEMORY", "1") != "0":
+        try:
+            with _section_timer("memory_model"):
+                memory_model = _memory_model()
+            merr = memory_model["calibrated_error_mean"]
+            if merr is not None:
+                mvs = _vs_baseline(baselines,
+                                   f"{platform}:mem_model_error_v1",
+                                   merr, base_path)
+                memory_model["vs_baseline"] = round(mvs, 4)
+        except Exception as exc:
+            print(f"bench: memory-model section failed "
+                  f"({type(exc).__name__}: {exc})", file=sys.stderr)
+
     # --- collectives: quantized + ring-overlapped FSDP comm layer ----------
     collectives = None
     t_comm = 90 if on_tpu else 60
@@ -1177,6 +1242,7 @@ def main() -> int:
         "autotune": autotune,
         "reshard": reshard,
         "observability": observability,
+        "memory_model": memory_model,
         "collectives": collectives,
         "flash_attention_speedup":
             round(attn_speedup, 3) if attn_speedup else None,
@@ -1305,7 +1371,7 @@ def orchestrate() -> int:
     shed = {"BENCH_SECONDARY": "0", "BENCH_LM": "0", "BENCH_INPUT": "0",
             "BENCH_ATTENTION": "0", "BENCH_SERVE": "0",
             "BENCH_RESILIENCE": "0", "BENCH_RESHARD": "0",
-            "BENCH_OBS": "0", "BENCH_COMM": "0"}
+            "BENCH_OBS": "0", "BENCH_COMM": "0", "BENCH_MEMORY": "0"}
     plan: list[dict] = [{}] if pinned else [
         {"BENCH_BATCH_PER_CHIP": "256"},
         {"BENCH_BATCH_PER_CHIP": "128", **shed},

@@ -23,6 +23,7 @@ import time
 from typing import Any, Callable
 
 from .export import EventWriter
+from .memory import MemoryTracker
 from .metrics import MetricsRegistry
 from .mfu import chip_peak_flops, measure_step_flops, mfu_record
 from .recorder import FlightRecorder
@@ -30,7 +31,7 @@ from .timeline import Timeline
 from .trace import Tracer
 
 __all__ = ["RunTelemetry", "MetricsRegistry", "Timeline", "EventWriter",
-           "Tracer", "FlightRecorder", "chip_peak_flops"]
+           "Tracer", "FlightRecorder", "MemoryTracker", "chip_peak_flops"]
 
 
 class RunTelemetry:
@@ -66,6 +67,9 @@ class RunTelemetry:
             max_bytes=int(rotate_mb * 1e6) if rotate_mb else None,
             fsync_on_rollover=fsync_on_rollover)
         self.clock = clock
+        # live memory gauges; resolves its device lazily on first sample,
+        # so constructing it here keeps the "no jax until asked" contract
+        self.memory = MemoryTracker(self.registry)
         # model-FLOP state (filled by measure_flops / note_train)
         self.step_flops: float | None = None
         self.n_devices: int | None = None
@@ -138,6 +142,10 @@ class RunTelemetry:
         self.writer.emit("obs_mfu", **rec)
         self.writer.emit("obs_snapshot", snapshot=snap)
         summary = {"goodput": gp, "mfu": rec, "snapshot": snap}
+        if self.memory.samples or self.memory.steps:
+            mem = self.memory.summary()
+            self.writer.emit("obs_memory", **mem)
+            summary["memory"] = mem
         if self.tracer is not None and self.trace_path:
             n = self.tracer.export(self.trace_path)
             self.writer.emit("obs_trace", path=self.trace_path, spans=n,

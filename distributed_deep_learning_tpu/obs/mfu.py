@@ -32,14 +32,23 @@ def chip_peak_flops(device_kind: str) -> float | None:
     """Peak dense-bf16 FLOP/s for a device kind, None when unknown
     (CPU, GPU kinds not in the table).  ``DDL_OBS_PEAK_FLOPS`` overrides
     for CPU smoke runs and tests."""
+    return chip_peak_flops_sourced(device_kind)[0]
+
+
+def chip_peak_flops_sourced(device_kind: str
+                            ) -> tuple[float | None, str | None]:
+    """(peak, source) where source says where the number came from:
+    ``"env_override"`` (``DDL_OBS_PEAK_FLOPS``) or ``"table"`` — the
+    label that keeps a CPU-box MFU record (synthetic peak) from being
+    read as a TPU-measured one."""
     env = os.environ.get("DDL_OBS_PEAK_FLOPS")
     if env:
-        return float(env)
+        return float(env), "env_override"
     kind = device_kind.lower()
     for sub, peak in PEAK_BF16_FLOPS:
         if sub in kind:
-            return peak
-    return None
+            return peak, "table"
+    return None, None
 
 
 def measure_step_flops(step_fn: Callable, *args, n_devices: int | None = None,
@@ -78,10 +87,14 @@ def mfu_record(step_flops: float | None, steps: float, seconds: float,
 
     ``step_flops`` is the GLOBAL (all-device) FLOPs of one step.  Any
     piece may be missing (None flops on odd backends, unknown peak on
-    CPU); the record degrades field-by-field instead of failing.
+    CPU); the record degrades field-by-field instead of failing.  Every
+    record carries ``peak_flops_source`` (``table`` / ``env_override`` /
+    ``caller`` / None) so readers can tell measured-hardware MFU from
+    synthetic-peak smoke numbers.
     """
+    source: str | None = "caller" if peak_flops is not None else None
     if peak_flops is None:
-        peak_flops = chip_peak_flops(device_kind)
+        peak_flops, source = chip_peak_flops_sourced(device_kind)
     steps_per_sec = steps / seconds if seconds > 0 else None
     achieved = (step_flops * steps_per_sec
                 if step_flops and steps_per_sec else None)
@@ -97,5 +110,6 @@ def mfu_record(step_flops: float | None, steps: float, seconds: float,
         "n_devices": n_devices,
         "device_kind": device_kind,
         "peak_flops_per_chip": peak_flops,
+        "peak_flops_source": source,
         "mfu": mfu,
     }

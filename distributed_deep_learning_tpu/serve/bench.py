@@ -186,6 +186,7 @@ def serving_bench(*, seed: int = 0, n_requests: int = 32,
         "max_slots": max_slots,
         "engine": {
             "tokens_per_sec": round(es["tokens_per_sec"], 2),
+            "kv_cache_bytes": es["kv_cache_bytes"],
             "prefill_seconds": round(es["prefill_seconds"], 3),
             "decode_seconds": round(es["decode_seconds"], 3),
             "mean_slot_occupancy": round(es["mean_slot_occupancy"], 3),
@@ -289,6 +290,7 @@ def paged_serving_bench(*, seed: int = 0,
         "errors": len(res["errors"]),
         "paged_engine": {
             "tokens_per_sec": round(ps["tokens_per_sec"], 2),
+            "kv_cache_bytes": ps["kv_cache_bytes"],
             "prefill_seconds": round(ps["prefill_seconds"], 3),
             "decode_seconds": round(ps["decode_seconds"], 3),
             "mean_slot_occupancy": round(ps["mean_slot_occupancy"], 3),

@@ -38,6 +38,7 @@ import numpy as np
 from distributed_deep_learning_tpu.models.transformer import (
     CausalLM, cached_apply, make_decode_model, sample_tokens,
     validate_sampling)
+from distributed_deep_learning_tpu.obs import memory as obs_memory
 from distributed_deep_learning_tpu.obs.metrics import MetricsRegistry
 from distributed_deep_learning_tpu.obs.window import LiveSignals
 from distributed_deep_learning_tpu.serve import cache as slot_cache
@@ -134,6 +135,10 @@ class ServeEngine:
         dk = {"donate_argnums": (1,)} if donate else {}
         self.slots = slot_cache.allocate_slots(self.lm, self.max_slots,
                                                self.max_len)
+        # exact KV footprint by construction: the allocated cache pytree's
+        # own shapes (what the analytic layers x 2 x slots x len x kv-heads
+        # x head-dim computation must reproduce bit-exactly)
+        self.kv_cache_bytes = obs_memory.pytree_bytes(self.slots)
         self._prefill = CountingJit(self._prefill_impl, **dk)
         self._decode = CountingJit(self._decode_impl, **dk)
 
@@ -232,6 +237,7 @@ class ServeEngine:
         h_tick = reg.histogram("serve_decode_tick_seconds")
         g_queue = reg.gauge("serve_queue_depth")
         g_occ = reg.gauge("serve_slot_occupancy")
+        reg.gauge("serve_kv_cache_bytes").set(self.kv_cache_bytes)
         first_wall: dict[int, float] = {}  # uid -> first-token wall time
 
         tracer = getattr(telemetry, "tracer", None) \
@@ -381,6 +387,7 @@ class ServeEngine:
             "mean_slot_occupancy":
                 occupancy_sum / decode_ticks if decode_ticks else 0.0,
             "max_slots": self.max_slots,
+            "kv_cache_bytes": self.kv_cache_bytes,
             "prefill_compiles": self._prefill.traces,
             "decode_compiles": self._decode.traces,
             "buckets": list(self.buckets),
@@ -504,6 +511,11 @@ class PagedEngine:
             self._verify = CountingJit(self._verify_impl, **dk)
             self._draft_chunk = CountingJit(self._draft_chunk_impl, **dk)
             self._draft_copy = CountingJit(self._draft_copy_impl, **ck)
+        # exact KV footprint: every allocated pool pytree (draft included
+        # when speculating) — the paged analogue of ServeEngine's slots
+        self.kv_cache_bytes = obs_memory.pytree_bytes(self.pools)
+        if draft_layers is not None:
+            self.kv_cache_bytes += obs_memory.pytree_bytes(self.draft_pools)
 
     # --- compiled programs (each traces exactly once) ---------------------
     def _sample(self, hidden_last, key):
@@ -694,6 +706,7 @@ class PagedEngine:
         g_occ = reg.gauge("serve_slot_occupancy")
         g_blocks = reg.gauge("serve_kv_blocks_in_use")
         g_hit = reg.gauge("serve_prefix_hit_rate")
+        reg.gauge("serve_kv_cache_bytes").set(self.kv_cache_bytes)
 
         # per-slot host state: the token stream (prompt + emitted), how
         # many positions hold committed KV, remaining chunk plans, and
@@ -1087,6 +1100,7 @@ class PagedEngine:
             "mean_slot_occupancy":
                 occupancy_sum / decode_ticks if decode_ticks else 0.0,
             "max_slots": self.max_slots,
+            "kv_cache_bytes": self.kv_cache_bytes,
             "kv_block_size": bs,
             "prefill_chunk": self.chunk,
             "chunk_compiles": self._chunk_prog.traces,

@@ -80,9 +80,12 @@ def _run_phase(step_fn, state, loader, *, train: bool, monitor=None,
     ``telemetry`` (:class:`..obs.RunTelemetry`) records per-step spans:
     ``data_wait`` around ``next(loader)``, ``dispatch`` around the step
     call (the FIRST dispatch of a given step fn attributed to
-    ``compile``), ``device_sync`` around the end-of-phase host fetch.
+    ``compile``), ``device_sync`` around the end-of-phase host fetch —
+    plus the memory tracker's subsampled watermark poll per trained step
+    (one int compare on backends that report no memory stats).
     The None path is the exact pre-telemetry loop — zero added work."""
     device_metrics = []
+    mem = getattr(telemetry, "memory", None) if train else None
     pending = None  # (batch_idx, metrics) awaiting the lag-1 anomaly check
     if skip and hasattr(loader, "iter_batches"):
         batches = loader.iter_batches(skip)  # skipped without materialising
@@ -122,6 +125,8 @@ def _run_phase(step_fn, state, loader, *, train: bool, monitor=None,
                 state, m = step_fn(state, x, y)
                 tl.add(kind, tl.clock() - t)
                 tl.step()
+                if mem is not None:
+                    mem.on_step()
         elif tl is None:
             m = step_fn(state, x, y)
         else:
@@ -158,7 +163,41 @@ def _result(phase: str, epoch: int | None, totals, t0: float, t1: float) -> Epoc
 
 
 def fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
-        test_loader, epochs: int, logger: PhaseLogger | None = None,
+        test_loader, epochs: int, *args, telemetry=None,
+        **kwargs) -> tuple[TrainState, list[EpochResult]]:
+    """Drive the epoch loop (see :func:`_fit` for the full contract).
+
+    This wrapper adds the OOM postmortem: when a ``RESOURCE_EXHAUSTED``
+    escapes the loop and a telemetry recorder is attached, the memory
+    tracker's watermark timeline and the largest state buffers are dumped
+    into the flight recorder before the exception propagates — the run
+    still dies, but it leaves an attributed black box."""
+    try:
+        return _fit(state, train_step, eval_step, train_loader, val_loader,
+                    test_loader, epochs, *args, telemetry=telemetry,
+                    **kwargs)
+    except Exception as err:
+        if telemetry is not None and getattr(telemetry, "recorder", None) \
+                is not None:
+            from distributed_deep_learning_tpu.obs import memory as obs_memory
+
+            if obs_memory.is_oom_error(err):
+                top = []
+                try:
+                    top = obs_memory.top_leaves(state, n=10)
+                except Exception:
+                    pass  # the postmortem must never mask the OOM
+                tracker = getattr(telemetry, "memory", None)
+                obs_memory.record_oom_postmortem(
+                    telemetry.recorder, error=err, top_buffers=top,
+                    watermarks=tracker.timeline
+                    if tracker is not None else None,
+                    context="train")
+        raise
+
+
+def _fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
+         test_loader, epochs: int, logger: PhaseLogger | None = None,
         checkpointer=None, start_epoch: int = 1, monitor=None,
         checkpoint_every: int | None = None, resume_batch: int = 0,
         resume_totals: dict | None = None,

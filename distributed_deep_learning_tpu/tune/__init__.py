@@ -18,12 +18,20 @@ the choice.  Four layers:
 * :mod:`.search` + :mod:`.artifact` — successive halving over survivors,
   and the versioned JSON plan artifact keyed by a hash of (workload,
   geometry, topology) that ``--plan`` replays.
+* :mod:`.calibrate` — measured calibration of the analytic model's
+  constants: compile the real step at the remat/ZeRO lattice corners,
+  fit ``ACT_FRACTION``/``RECOMPUTE_COST`` from XLA's measured bytes and
+  step rates into a versioned artifact the search consumes.
 """
 
 from distributed_deep_learning_tpu.tune.artifact import (PLAN_SCHEMA_VERSION,
                                                          StalePlanError,
                                                          load_plan, plan_hash,
                                                          plan_key, save_plan)
+from distributed_deep_learning_tpu.tune.calibrate import (
+    CALIBRATION_SCHEMA_VERSION, MemoryCalibration, StaleCalibrationError,
+    calibration_key, load_calibration, maybe_load_calibration,
+    run_calibration, save_calibration)
 from distributed_deep_learning_tpu.tune.memory import (MemoryEstimate,
                                                        ModelGeometry,
                                                        estimate_memory,
@@ -37,7 +45,10 @@ from distributed_deep_learning_tpu.tune.trial import TrialHarness, TrialResult
 
 __all__ = [
     "PLAN_SCHEMA_VERSION", "StalePlanError", "load_plan", "plan_hash",
-    "plan_key", "save_plan", "MemoryEstimate", "ModelGeometry",
+    "plan_key", "save_plan", "CALIBRATION_SCHEMA_VERSION",
+    "MemoryCalibration", "StaleCalibrationError", "calibration_key",
+    "load_calibration", "maybe_load_calibration", "run_calibration",
+    "save_calibration", "MemoryEstimate", "ModelGeometry",
     "estimate_memory", "hbm_budget", "prune_plans", "SearchResult",
     "run_search", "Plan", "apply_plan", "enumerate_plans",
     "plan_from_config", "TrialHarness", "TrialResult",

@@ -23,7 +23,7 @@ meshes), in which case pruning only happens under an explicit override.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Iterable, Sequence
+from typing import Any, Iterable, Mapping, Sequence
 
 from distributed_deep_learning_tpu.tune.space import Plan
 
@@ -82,9 +82,28 @@ def _shard_axis_size(plan: Plan) -> int:
     return fsdp if fsdp > 1 else md.get("data", 1)
 
 
-def estimate_memory(plan: Plan, geom: ModelGeometry,
-                    batch_size: int) -> MemoryEstimate:
-    """Analytic per-device HBM footprint of one train step."""
+def resolve_act_fraction(plan: Plan,
+                         act_fraction: Mapping[tuple[bool, str], float]
+                         | None = None) -> float:
+    """The activation fraction for a plan's remat corner: the measured
+    (calibrated) value when one is supplied, the static analytic table
+    otherwise.  A calibration that lacks this corner falls back
+    per-corner — partial calibrations never lose the analytic model."""
+    key = (plan.remat, plan.remat_policy)
+    if act_fraction is not None and key in act_fraction:
+        return float(act_fraction[key])
+    return ACT_FRACTION[key]
+
+
+def estimate_memory(plan: Plan, geom: ModelGeometry, batch_size: int,
+                    *, act_fraction: Mapping[tuple[bool, str], float]
+                    | None = None) -> MemoryEstimate:
+    """Analytic per-device HBM footprint of one train step.
+
+    ``act_fraction`` optionally replaces the static :data:`ACT_FRACTION`
+    table with measured per-corner constants (a
+    :class:`~.calibrate.MemoryCalibration`'s ``act_fraction`` map);
+    corners it doesn't cover keep the analytic value."""
     dtype_bytes = 2 if plan.dtype == "bfloat16" else 4
     shard = max(1, _shard_axis_size(plan))
     params = geom.param_count * 4          # fp32 master copy
@@ -97,7 +116,7 @@ def estimate_memory(plan: Plan, geom: ModelGeometry,
         grads = -(-grads // shard)
         opt = -(-opt // shard)
     micro = max(1, batch_size // (plan.dp * plan.grad_accum))
-    frac = ACT_FRACTION[(plan.remat, plan.remat_policy)]
+    frac = resolve_act_fraction(plan, act_fraction)
     act = int(micro * (geom.num_layers * geom.layer_act_elems_per_example
                        * frac + geom.extra_act_elems_per_example)
               * dtype_bytes)
@@ -128,17 +147,20 @@ def hbm_budget(devices: Sequence[Any] | None = None,
 
 def prune_plans(plans: Iterable[Plan], geom: ModelGeometry, batch_size: int,
                 budget_bytes: int | None, *, safety: float = 0.9,
+                act_fraction: Mapping[tuple[bool, str], float] | None = None,
                 ) -> tuple[list[Plan], list[tuple[Plan, MemoryEstimate]]]:
     """Split plans into (feasible, rejected-with-estimates).
 
     ``safety`` reserves headroom for XLA temporaries the analytic model
     cannot see (fusion scratch, collective buffers).  With no budget the
     model cannot reject anything — every plan is feasible and the measured
-    trials' OOM containment is the backstop."""
+    trials' OOM containment is the backstop.  ``act_fraction`` threads a
+    calibration's measured constants into every estimate."""
     feasible: list[Plan] = []
     rejected: list[tuple[Plan, MemoryEstimate]] = []
     for plan in plans:
-        est = estimate_memory(plan, geom, batch_size)
+        est = estimate_memory(plan, geom, batch_size,
+                              act_fraction=act_fraction)
         if budget_bytes is not None and est.total_bytes > safety * budget_bytes:
             rejected.append((plan, est))
         else:

@@ -53,9 +53,18 @@ RECOMPUTE_COST = {
 _SEQ_WORKLOADS = ("gpt", "bert", "transformer", "moe", "lstm")
 
 
-def analytic_score(plan: Plan) -> float:
-    """Lower = expected faster; a coarse pre-compile ranking only."""
-    score = RECOMPUTE_COST[(plan.remat, plan.remat_policy)]
+def analytic_score(plan: Plan, recompute_cost: dict[tuple[bool, str], float]
+                   | None = None) -> float:
+    """Lower = expected faster; a coarse pre-compile ranking only.
+
+    ``recompute_cost`` optionally replaces the static table with a
+    calibration's measured per-corner step-cost ratios; corners it
+    doesn't cover keep the analytic value."""
+    key = (plan.remat, plan.remat_policy)
+    if recompute_cost is not None and key in recompute_cost:
+        score = float(recompute_cost[key])
+    else:
+        score = RECOMPUTE_COST[key]
     score *= 1.0 + 0.05 * (plan.grad_accum - 1)   # scan overhead
     if plan.zero == "1":
         score *= 1.05                             # moment allgather
@@ -147,6 +156,7 @@ def run_search(spec, config: Config, *, devices=None, dataset=None,
                measure: Callable[[Plan, int], float] | None = None,
                oom_hook: Callable[[Plan], None] | None = None,
                space_options: dict[str, Sequence] | None = None,
+               calibration=None,
                ) -> SearchResult:
     """Search the plan lattice for `spec` under `config`'s geometry.
 
@@ -154,7 +164,12 @@ def run_search(spec, config: Config, *, devices=None, dataset=None,
     dtypes / zero / compress / accumulation for cheap searches);
     ``max_trials=None`` lifts the pool cap.  ``measure`` / ``oom_hook``
     are the deterministic / chaos injection points (see
-    :class:`~.trial.TrialHarness`)."""
+    :class:`~.trial.TrialHarness`).  ``calibration`` is an optional
+    :class:`~.calibrate.MemoryCalibration`: its measured ``act_fraction``
+    constants replace the analytic table in pruning and memory-ranked
+    ordering, its ``recompute_cost`` the static step-cost multipliers in
+    the analytic score — corners a calibration doesn't cover fall back to
+    the tables per-corner."""
     t_start = time.perf_counter()
     if devices is None:
         from distributed_deep_learning_tpu.workloads.base import _devices
@@ -169,15 +184,19 @@ def run_search(spec, config: Config, *, devices=None, dataset=None,
     plans = enumerate_plans(n, config.batch_size, **opts)
     geom = model_geometry(spec, config, dataset)
     budget = hbm_budget(devices, override=budget_bytes)
-    feasible, rejected = prune_plans(plans, geom, config.batch_size, budget)
+    act_fraction = getattr(calibration, "act_fraction", None)
+    recompute_cost = getattr(calibration, "recompute_cost", None)
+    feasible, rejected = prune_plans(plans, geom, config.batch_size, budget,
+                                     act_fraction=act_fraction)
     if not feasible:
         raise ValueError(
             f"memory model pruned all {len(plans)} candidate plans "
             f"(budget {budget} bytes); nothing to measure")
 
     order = sorted(feasible, key=lambda p: (
-        analytic_score(p),
-        estimate_memory(p, geom, config.batch_size).total_bytes,
+        analytic_score(p, recompute_cost),
+        estimate_memory(p, geom, config.batch_size,
+                        act_fraction=act_fraction).total_bytes,
         plan_hash(p)))
     n_capped = 0
     if max_trials is not None and len(order) > max_trials:

@@ -29,21 +29,14 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_deep_learning_tpu.data.loader import BATCH_AXES
+# is_oom_error's canonical home is obs.memory (the postmortem path needs
+# it without importing tune/); re-exported here for existing callers
+from distributed_deep_learning_tpu.obs.memory import is_oom_error  # noqa: F401
 from distributed_deep_learning_tpu.runtime.mesh import build_mesh
 from distributed_deep_learning_tpu.train.state import create_train_state
 from distributed_deep_learning_tpu.train.step import place_state
 from distributed_deep_learning_tpu.tune.space import Plan, apply_plan
 from distributed_deep_learning_tpu.utils import profiling
-
-
-def is_oom_error(err: BaseException) -> bool:
-    """Does this exception smell like device memory exhaustion?  XLA
-    surfaces OOM as ``XlaRuntimeError`` with RESOURCE_EXHAUSTED status —
-    matched on the message because the exception class moved across
-    jaxlib versions."""
-    msg = str(err)
-    return ("RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()
-            or "OOM" in msg)
 
 
 @dataclasses.dataclass
@@ -90,11 +83,18 @@ class TrialHarness:
     any build — a test can raise a fake ``RESOURCE_EXHAUSTED`` there;
     ``measure(plan, steps) -> steps_per_sec`` replaces the build+timing
     path entirely for deterministic search-logic tests.
+
+    ``recorder`` (a :class:`~..obs.recorder.FlightRecorder`) turns an
+    OOM'd candidate into a postmortem: the dump names the active plan
+    and the top-N largest state buffers (from ``jax.eval_shape`` over the
+    real ``model.init`` — deterministic shapes, no compile, so a
+    seq-clock recorder dumps bit-identical bytes across runs).
     """
 
     def __init__(self, spec, config, dataset, devices, *, warmup: int = 2,
                  oom_hook: Callable[[Plan], None] | None = None,
-                 measure: Callable[[Plan, int], float] | None = None):
+                 measure: Callable[[Plan, int], float] | None = None,
+                 recorder=None):
         self.spec = spec
         self.config = config
         self.dataset = dataset
@@ -102,6 +102,7 @@ class TrialHarness:
         self.warmup = warmup
         self.oom_hook = oom_hook
         self.measure = measure
+        self.recorder = recorder
         x, y = dataset.batch(np.arange(config.batch_size))
         self._x, self._y = np.asarray(x), np.asarray(y)
 
@@ -117,8 +118,31 @@ class TrialHarness:
                                    measured_steps=steps)
             return self._run_real(cfg, plan, steps)
         except Exception as err:  # a dead candidate must not kill the search
-            return TrialResult(plan, infeasible=True, oom=is_oom_error(err),
+            oom = is_oom_error(err)
+            if oom and self.recorder is not None:
+                self._record_postmortem(cfg, plan, err)
+            return TrialResult(plan, infeasible=True, oom=oom,
                                error=f"{type(err).__name__}: {err}"[:500])
+
+    def _record_postmortem(self, cfg, plan: Plan, err: BaseException) -> None:
+        """Dump the OOM story into the flight recorder.  Buffer names come
+        from the abstract init shapes — exact, compile-free, and identical
+        across runs — so the drill's determinism criterion holds even when
+        the OOM struck before anything was allocated."""
+        from distributed_deep_learning_tpu.obs import memory as obs_memory
+
+        top = []
+        try:
+            model = self.spec.build_model(cfg, self.dataset)
+            example = self.spec.example_input(cfg, self.dataset)
+            shapes = jax.eval_shape(model.init, jax.random.key(cfg.seed),
+                                    example)
+            top = obs_memory.top_leaves(shapes, n=10)
+        except Exception:
+            pass  # the postmortem must never out-crash the trial
+        obs_memory.record_oom_postmortem(
+            self.recorder, error=err, plan=plan.to_dict(),
+            top_buffers=top, context="trial")
 
     def _run_real(self, cfg, plan: Plan, steps: int) -> TrialResult:
         from distributed_deep_learning_tpu.workloads import base

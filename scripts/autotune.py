@@ -55,6 +55,13 @@ def main(argv=None) -> int:
                    help="search ZeRO/compress/accumulation too (default: "
                         "mesh x remat only — the cheap, always-relevant "
                         "axes)")
+    p.add_argument("--calibration", default=None, metavar="FILE",
+                   help="measured memory-model calibration artifact "
+                        "(tune.calibrate) — its fitted ACT_FRACTION/"
+                        "RECOMPUTE_COST constants replace the analytic "
+                        "tables for pruning and ranking; stale artifacts "
+                        "(foreign schema/key) are an error, a missing "
+                        "file falls back to the analytic model")
     args, rest = p.parse_known_args(argv)
 
     _script_env()
@@ -94,10 +101,20 @@ def main(argv=None) -> int:
 
     from distributed_deep_learning_tpu.tune.search import run_search
 
+    calibration = None
+    if args.calibration:
+        from distributed_deep_learning_tpu.tune import calibrate
+
+        cal_key = calibrate.calibration_key(
+            spec.name, config, n, devices[0].platform,
+            getattr(devices[0], "device_kind", ""))
+        calibration = calibrate.maybe_load_calibration(
+            args.calibration, expected_key=cal_key)
+
     result = run_search(
         spec, config, devices=devices, trial_steps=args.trial_steps,
         max_trials=args.trials or None, budget_bytes=args.budget_bytes,
-        space_options=space_options)
+        space_options=space_options, calibration=calibration)
     key = artifact.plan_key(spec.name, config, n, devices[0].platform,
                             getattr(devices[0], "device_kind", ""))
     out = args.out or f"autotune_{spec.name}.plan.json"
@@ -107,6 +124,9 @@ def main(argv=None) -> int:
                        search=result.record())
     record = result.record()
     record["artifact"] = out
+    if args.calibration:
+        record["calibration"] = {"path": args.calibration,
+                                 "loaded": calibration is not None}
     print(json.dumps(record))
     return 0
 

@@ -1,0 +1,104 @@
+"""Consistency gate between ``bench_baseline.json`` and the sentry bands.
+
+The perf-regression sentry (``bench.py``) only defends a baseline key
+when ``REGRESSION_BANDS`` carries a band for its suffix — a key the
+bands don't know is silently unguarded, and a band no baseline matches
+guards nothing.  Both drifts are one forgotten edit away (add a metric,
+rename a key, retire a section), so this script fails fast when they
+happen; ``tests/test_memory_obs.py`` runs it as a tier-1 test.
+
+    python scripts/check_baselines.py            # rc 0 clean, 1 on drift
+
+Checks:
+
+* every banded baseline key's suffix matches a ``REGRESSION_BANDS``
+  entry, OR the key sits on the explicit legacy allowlist (pre-sentry
+  records kept for history: list values, one-off micro ratios);
+* every allowlist entry still exists in the baseline file (a stale
+  allowlist hides future drift);
+* every band is well-formed (known mode, positive value);
+* every band matches at least one baseline key (orphaned bands mean the
+  metric was renamed or its section lost its ``_vs_baseline`` call).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+#: pre-sentry baseline keys kept for history (TPU harvest one-offs and
+#: the legacy densenet v1 record) — tracked, not banded.  Adding a key
+#: here is an explicit decision to leave it unguarded.
+UNBANDED_ALLOWLIST = frozenset({
+    "tpu:densenet_bc_train",
+    "tpu:resnet50_mfu_v1",
+    "tpu:flash_best_blocks",
+    "tpu:flash_speedup_T2048_D64",
+    "tpu:s2d_stem_speedup_b128",
+    "tpu:gqa_flash_speedup_H8_Hkv2",
+})
+
+_MODES = ("higher", "lower_abs")
+
+
+def check(baselines: dict, bands: dict,
+          allow_unbanded: frozenset = UNBANDED_ALLOWLIST) -> list[str]:
+    """All drift findings, empty when consistent (unit-testable core)."""
+    problems: list[str] = []
+    for key in sorted(baselines):
+        suffix = key.split(":", 1)[-1]
+        if suffix in bands:
+            continue
+        if key in allow_unbanded:
+            continue
+        problems.append(
+            f"baseline key {key!r} has no REGRESSION_BANDS entry for "
+            f"suffix {suffix!r} (unguarded metric; add a band or "
+            "allowlist it explicitly)")
+    for key in sorted(allow_unbanded):
+        if key not in baselines:
+            problems.append(
+                f"allowlist entry {key!r} is not in the baseline file "
+                "(stale allowlist; remove it)")
+    suffixes = {k.split(":", 1)[-1] for k in baselines}
+    for suffix in sorted(bands):
+        rule = bands[suffix]
+        if (not isinstance(rule, (tuple, list)) or len(rule) != 2
+                or rule[0] not in _MODES):
+            problems.append(
+                f"band {suffix!r} is malformed: {rule!r} (want "
+                f"(mode, value) with mode in {_MODES})")
+            continue
+        if not isinstance(rule[1], (int, float)) or rule[1] <= 0:
+            problems.append(
+                f"band {suffix!r} has non-positive value {rule[1]!r}")
+        if suffix not in suffixes:
+            problems.append(
+                f"band {suffix!r} matches no baseline key (orphaned "
+                "band: metric renamed, or its section never calls "
+                "_vs_baseline)")
+    return problems
+
+
+def main(argv=None) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    path = (argv or [None])[0] if argv else None
+    path = path or os.path.join(repo, "bench_baseline.json")
+
+    import bench
+
+    with open(path) as f:
+        baselines = json.load(f)
+    problems = check(baselines, bench.REGRESSION_BANDS)
+    for p in problems:
+        print(f"check_baselines: {p}", file=sys.stderr)
+    print(json.dumps({"baselines": len(baselines),
+                      "bands": len(bench.REGRESSION_BANDS),
+                      "problems": len(problems)}))
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -11,6 +11,7 @@ users saw.
     python scripts/obs_report.py obs_events.jsonl --prom     # Prometheus text
     python scripts/obs_report.py obs_events.jsonl --trace    # span trace
     python scripts/obs_report.py obs_events.jsonl --window   # live windows
+    python scripts/obs_report.py obs_events.jsonl --memory   # memory view
 
 ``--prom`` dumps the final metrics snapshot in Prometheus text
 exposition format (for a textfile collector or diffing against a scrape
@@ -136,6 +137,47 @@ def render_trace(spans: list[dict], limit: int = 40) -> str:
     return "\n".join(out)
 
 
+def render_memory(events: list[dict]) -> str:
+    """The run's memory story: the ``obs_memory`` rollup (HBM watermark
+    timeline, host RSS) plus the final snapshot's ``mem_*`` /
+    ``serve_kv_cache_bytes`` gauges."""
+    mems = [e for e in events if e.get("event") == "obs_memory"]
+    snaps = [e for e in events if e.get("event") == "obs_snapshot"]
+    out = []
+    for mem in mems[-1:]:
+        out.append("== memory (run) ==")
+        reports = mem.get("device_reports_memory")
+        out.append(f"  samples {mem.get('samples')} over "
+                   f"{mem.get('steps')} steps  "
+                   f"(device reports memory: {reports})")
+        if mem.get("peak_bytes"):
+            out.append(f"  HBM peak        {_fmt_bytes(mem['peak_bytes'])}")
+        if mem.get("host_rss_bytes"):
+            out.append(f"  host RSS        "
+                       f"{_fmt_bytes(mem['host_rss_bytes'])}")
+        tail = mem.get("timeline_tail") or []
+        if tail:
+            out.append("  step   in-use        peak          peak-delta")
+            for s in tail:
+                out.append(
+                    f"  {s.get('step', 0):<6}"
+                    f"{_fmt_bytes(s.get('bytes_in_use', 0)):>10}  "
+                    f"{_fmt_bytes(s.get('peak_bytes', 0)):>10}  "
+                    f"{_fmt_bytes(s.get('peak_delta', 0)):>10}")
+    if snaps:
+        gauges = snaps[-1].get("snapshot", {}).get("gauges", {})
+        rows = [(k, v) for k, v in sorted(gauges.items())
+                if k.startswith("mem_") or "kv_cache_bytes" in k]
+        if rows:
+            out.append("== memory gauges (final snapshot) ==")
+            for k, v in rows:
+                out.append(f"  {k:<28}{_fmt_bytes(v):>12}")
+    if not out:
+        out.append("no obs_memory events or mem_* gauges in the stream "
+                   "(was the run started with --obs?)")
+    return "\n".join(out)
+
+
 def render_window(events: list[dict]) -> str:
     """The rolling-window live signals over the run, one line per
     ``obs_window`` emit (engines emit at most one per second)."""
@@ -204,8 +246,11 @@ def render(events: list[dict], phases: bool = False) -> str:
         if mfu.get("achieved_flops_per_sec"):
             out.append(f"  achieved FLOP/s {mfu['achieved_flops_per_sec']:.3e}")
         if mfu.get("mfu") is not None:
+            src = mfu.get("peak_flops_source")
+            src_note = f", peak source: {src}" if src else ""
             out.append(f"  MFU             {100.0 * mfu['mfu']:.2f}% "
-                       f"(peak {mfu['peak_flops_per_chip']:.3e}/chip)")
+                       f"(peak {mfu['peak_flops_per_chip']:.3e}/chip"
+                       f"{src_note})")
         else:
             out.append("  MFU             n/a (no peak-FLOPs table entry "
                        "for this device; set DDL_OBS_PEAK_FLOPS)")
@@ -251,6 +296,9 @@ def main(argv=None) -> int:
     p.add_argument("--window", action="store_true",
                    help="print the rolling-window live signals "
                         "(obs_window events) instead of the report")
+    p.add_argument("--memory", action="store_true",
+                   help="print the memory view (obs_memory rollup + "
+                        "mem_*/kv-cache gauges) instead of the report")
     args = p.parse_args(argv)
 
     from distributed_deep_learning_tpu.obs.export import (prometheus_text,
@@ -278,6 +326,9 @@ def main(argv=None) -> int:
         return 0
     if args.window:
         print(render_window(events))
+        return 0
+    if args.memory:
+        print(render_memory(events))
         return 0
     if args.prom:
         snaps = [e for e in events if e.get("event") == "obs_snapshot"]

@@ -49,7 +49,13 @@ def test_normalize_memory_analysis_handles_missing():
     class Partial:                       # older jaxlibs expose fewer fields
         temp_size_in_bytes = 7
 
-    assert normalize_memory_analysis(Partial()) == {"temp_size_in_bytes": 7}
+    # missing required fields are zero-filled and flagged, so memory
+    # consumers (obs/memory, tune/calibrate) never KeyError mid-run
+    assert normalize_memory_analysis(Partial()) == {
+        "temp_size_in_bytes": 7,
+        "alias_size_in_bytes": 0,
+        "memory_fields_missing": ["alias_size_in_bytes"],
+    }
 
 
 def test_normalize_cost_analysis_unwraps_list():
