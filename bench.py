@@ -460,6 +460,23 @@ def _resilience() -> dict | None:
     return {"metric": "self-healing drill (chaos-injected)", **rec}
 
 
+def _serve_resilience() -> dict | None:
+    """Serve-side self-healing drill (ISSUE 13): engine crash / NaN
+    logits / corrupted KV block / stalled tick injected mid-decode under
+    the supervisor (zero requests lost, bit-identical replay), slow-tick
+    SLO load under admission control, and the hot weight-swap gauntlet
+    (canary promote, canary rollback, bit-flipped publication rejected
+    by the integrity manifest) — the same code path
+    ``scripts/chaos_drill.py --scenario serve`` exposes.  One engine
+    survives the whole gauntlet; ``decode_compiles`` staying 1 is part
+    of the record."""
+    from distributed_deep_learning_tpu.utils.chaos import (
+        run_serve_resilience_drill)
+
+    return run_serve_resilience_drill(
+        seed=int(os.environ.get("BENCH_CHAOS_SEED", "0")))
+
+
 def _autotune() -> dict | None:
     """Auto-parallelism planner (ISSUE 5): search the plan lattice for the
     MLP workload on this box's devices and report best-vs-default measured
@@ -788,6 +805,15 @@ REGRESSION_BANDS: dict[str, tuple[str, float]] = {
     # corners; a ratio against a near-zero baseline would be meaningless,
     # so the bar itself is the gate
     "mem_model_error_v1": ("lower_abs", 0.25),
+    # serve self-healing drill (ISSUE 13): absolute bars, not ratios —
+    # a fault the watchdog needs >3 ticks to see, a recovery past 5 s on
+    # the tiny drill engine, or ANY lost request is a broken chain no
+    # matter what an earlier run recorded.  Clean SLO attainment ratios
+    # against its record with a wide band (wall-clock CI noise).
+    "serve_resilience_detection_ticks_v1": ("lower_abs", 3.0),
+    "serve_resilience_recovery_s_v1": ("lower_abs", 5.0),
+    "serve_resilience_requests_lost_v1": ("lower_abs", 0.5),
+    "serve_resilience_slo_attainment_v1": ("higher", 0.5),
 }
 
 
@@ -1098,6 +1124,34 @@ def main() -> int:
             print(f"bench: resilience section failed "
                   f"({type(exc).__name__}: {exc})", file=sys.stderr)
 
+    # --- serve resilience: supervisor + hot swap under injected faults -----
+    serve_resilience = None
+    t_sres = 150 if on_tpu else 120
+    if os.environ.get("BENCH_SERVE_RESILIENCE", "1") != "0" and \
+            _time_left() < t_sres:
+        print(f"bench: shedding serve-resilience section "
+              f"({_time_left():.0f}s left)", file=sys.stderr)
+    elif os.environ.get("BENCH_SERVE_RESILIENCE", "1") != "0":
+        try:
+            with _section_timer("serve_resilience"):
+                serve_resilience = _serve_resilience()
+            for bkey, val in (
+                    ("serve_resilience_detection_ticks_v1",
+                     serve_resilience.get("detection_ticks_max")),
+                    ("serve_resilience_recovery_s_v1",
+                     serve_resilience.get("recovery_seconds_max")),
+                    ("serve_resilience_requests_lost_v1",
+                     serve_resilience.get("requests_lost_total")),
+                    ("serve_resilience_slo_attainment_v1",
+                     serve_resilience.get("slo_attainment_clean"))):
+                if val is not None:
+                    serve_resilience[bkey.replace("_v1", "_vs_baseline")] = \
+                        round(_vs_baseline(baselines, f"{platform}:{bkey}",
+                                           float(val), base_path), 4)
+        except Exception as exc:
+            print(f"bench: serve-resilience section failed "
+                  f"({type(exc).__name__}: {exc})", file=sys.stderr)
+
     # --- autotune: planner search vs hand default ---------------------------
     autotune = None
     t_tune = 120 if on_tpu else 60
@@ -1239,6 +1293,7 @@ def main() -> int:
         "input_pipeline": input_pipe,
         "serving": serving,
         "resilience": resilience,
+        "serve_resilience": serve_resilience,
         "autotune": autotune,
         "reshard": reshard,
         "observability": observability,
@@ -1370,8 +1425,9 @@ def orchestrate() -> int:
     # set can never fit, but headline-only with a warm compile cache can).
     shed = {"BENCH_SECONDARY": "0", "BENCH_LM": "0", "BENCH_INPUT": "0",
             "BENCH_ATTENTION": "0", "BENCH_SERVE": "0",
-            "BENCH_RESILIENCE": "0", "BENCH_RESHARD": "0",
-            "BENCH_OBS": "0", "BENCH_COMM": "0", "BENCH_MEMORY": "0"}
+            "BENCH_RESILIENCE": "0", "BENCH_SERVE_RESILIENCE": "0",
+            "BENCH_RESHARD": "0", "BENCH_OBS": "0", "BENCH_COMM": "0",
+            "BENCH_MEMORY": "0"}
     plan: list[dict] = [{}] if pinned else [
         {"BENCH_BATCH_PER_CHIP": "256"},
         {"BENCH_BATCH_PER_CHIP": "128", **shed},

@@ -226,6 +226,46 @@ def run_paged(model, params, requests: Sequence[Request], telemetry=None,
                    keep_timeline=keep_timeline)
 
 
+def run_supervised(model, params, requests: Sequence[Request], *,
+                   paged: bool = False, telemetry=None,
+                   deadline_ms: Optional[float] = None, retries: int = 2,
+                   stall_timeout_s: Optional[float] = None,
+                   reload_watch: Optional[str] = None,
+                   canary_slots: int = 2,
+                   admission: Optional[dict] = None,
+                   **engine_kw) -> dict:
+    """One SUPERVISED engine lifetime over the trace: same
+    ``{"results", "errors", "stats"}`` contract as :func:`run_engine` /
+    :func:`run_paged`, with the engine run under
+    :class:`..serve.supervisor.ServeSupervisor` — tick watchdog, crash
+    containment with zero-loss replay, per-request deadlines and bounded
+    retries.  ``reload_watch`` additionally wires hot weight reload
+    (:class:`..serve.reload.ReloadManager` watching that directory, with
+    ``canary_slots`` of canary before promote); ``admission`` is a
+    kwargs dict for :class:`..serve.admission.AdmissionController`
+    (``utils/config.parse_admission_arg`` produces it from the CLI).
+    The engine-level stats land under ``stats["engine"]``."""
+    from distributed_deep_learning_tpu.serve.supervisor import ServeSupervisor
+
+    eng = (PagedEngine if paged else ServeEngine)(model, params,
+                                                  **engine_kw)
+    rm = None
+    if reload_watch is not None:
+        from distributed_deep_learning_tpu.serve.reload import ReloadManager
+
+        rm = ReloadManager(reload_watch, canary_slots=canary_slots)
+    adm = None
+    if admission is not None:
+        from distributed_deep_learning_tpu.serve.admission import (
+            AdmissionController)
+
+        adm = AdmissionController(**admission)
+    sup = ServeSupervisor(eng, deadline_ms=deadline_ms, retries=retries,
+                          stall_timeout_s=stall_timeout_s, reload=rm,
+                          admission=adm)
+    return sup.run(requests, telemetry=telemetry)
+
+
 def paged_max_len(model_max_len: int, kv_block_size: int,
                   draft: bool, spec_k: int) -> int:
     """Largest engine ``max_len`` a model geometry supports: the paged

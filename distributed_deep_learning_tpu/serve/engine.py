@@ -28,8 +28,9 @@ of mixed lengths and staggered arrivals.
 
 from __future__ import annotations
 
+import dataclasses
 import time
-from typing import Iterable, Optional, Sequence
+from typing import Callable, Iterable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -74,6 +75,95 @@ class CountingJit:
 
     def __call__(self, *args):
         return self._jit(*args)
+
+
+@dataclasses.dataclass(frozen=True)
+class TickReport:
+    """What one engine tick produced, handed to ``run(on_tick=...)``
+    BEFORE the tokens are recorded into the scheduler.
+
+    This ordering is the crash-containment contract: a hook that raises
+    (watchdog anomaly, injected fault) discards the tick's tokens, so a
+    supervisor that replays from the committed streams regenerates them
+    — greedy outputs stay bit-identical to a fault-free run.
+
+    ``finite`` carries DEVICE-computed per-request flags (``isfinite``
+    over the sampled hidden state): NaN/inf anywhere in a request's
+    attention window poisons its flag, which is how KV corruption
+    surfaces one tick after injection.  ``logprob`` is the chosen
+    token's log-probability under the engine's own head — the drift
+    signal canary comparison feeds on.
+    """
+
+    tick: int
+    kind: str                      # "prefill" | "decode"
+    elapsed_s: float
+    emitted: list                  # [(uid, token), ...] in commit order
+    finite: dict                   # uid -> bool
+    logprob: dict                  # uid -> float
+    slots: list                    # active slot indices this tick
+    engine: object
+    queue_depth: int = 0
+
+
+@dataclasses.dataclass
+class _CanaryState:
+    """Live canary: candidate weights serving a slice of slots.
+
+    The engine runs the SAME compiled decode program twice per tick —
+    once with the stable params (canary slots' KV writes routed to
+    trash), once with the candidate params (everyone else's writes
+    trashed) — and merges tokens per slot.  Same shapes/dtypes both
+    calls, so the trace count never moves.  Per canary slot per tick it
+    feeds ``observe`` with the old-vs-new argmax agreement and chosen
+    log-prob drift; the reload manager turns those into windowed
+    signals and a promote/rollback verdict."""
+
+    params: object
+    slots: frozenset
+    observe: Optional[Callable] = None
+    compared: int = 0
+    agreed: int = 0
+    drift_sum: float = 0.0
+    nonfinite: int = 0
+
+    def note(self, agree: bool, drift: float, finite: bool,
+             now: float) -> None:
+        self.compared += 1
+        self.agreed += int(agree)
+        self.drift_sum += drift
+        self.nonfinite += int(not finite)
+        if self.observe is not None:
+            self.observe(agree=agree, drift=drift, finite=finite, now=now)
+
+    def summary(self) -> dict:
+        return {
+            "compared": self.compared,
+            "agreed": self.agreed,
+            "acceptance": (self.agreed / self.compared
+                           if self.compared else None),
+            "mean_abs_logprob_drift": (self.drift_sum / self.compared
+                                       if self.compared else None),
+            "nonfinite": self.nonfinite,
+            "canary_slots": sorted(self.slots),
+        }
+
+
+def _check_swappable(old, new) -> None:
+    """New params must be drop-in for the compiled programs: identical
+    tree structure, per-leaf shape and dtype — anything else would
+    retrace (or worse, silently reshape)."""
+    old_l, old_t = jax.tree_util.tree_flatten(old)
+    new_l, new_t = jax.tree_util.tree_flatten(new)
+    if old_t != new_t:
+        raise ValueError("swap_params: new params tree structure differs "
+                         "from the engine's (cannot hot-swap)")
+    for i, (a, b) in enumerate(zip(old_l, new_l)):
+        if a.shape != b.shape or a.dtype != b.dtype:
+            raise ValueError(
+                f"swap_params: leaf {i} mismatch — engine has "
+                f"{a.shape}/{a.dtype}, new params have {b.shape}/"
+                f"{b.dtype}; hot swap requires identical geometry")
 
 
 def default_buckets(max_len: int, floor: int = 8) -> tuple[int, ...]:
@@ -141,12 +231,23 @@ class ServeEngine:
         self.kv_cache_bytes = obs_memory.pytree_bytes(self.slots)
         self._prefill = CountingJit(self._prefill_impl, **dk)
         self._decode = CountingJit(self._decode_impl, **dk)
+        self.restarts = 0
+        self.weight_swaps = 0
 
     # --- the two compiled programs ---------------------------------------
-    def _sample(self, hidden_last, key):
-        return sample_tokens(self.model, self.params, hidden_last, key,
-                             temperature=self.temperature,
-                             top_k=self.top_k, top_p=self.top_p)
+    def _sample(self, params, hidden_last, key):
+        """Sample tokens plus their log-probability and a finiteness
+        flag per row.  ``params`` is an explicit TRACED argument — NOT a
+        closure capture, which jit would bake into the compiled program
+        as constants and hot weight swap would then silently miss."""
+        toks, _ = sample_tokens(self.model, params, hidden_last, key,
+                                temperature=self.temperature,
+                                top_k=self.top_k, top_p=self.top_p)
+        nl = self.model.logits_from({"params": params}, hidden_last)
+        lp = jnp.take_along_axis(jax.nn.log_softmax(nl, axis=-1),
+                                 toks[:, None], axis=-1)[:, 0]
+        ok = jnp.isfinite(hidden_last).all(axis=-1)
+        return toks, lp, ok
 
     def _prefill_impl(self, params, slots, tokens, slot, true_len, key):
         """(Pb,)-padded prompt -> slot ``slot`` filled, first token out."""
@@ -156,8 +257,8 @@ class ServeEngine:
         slots = slot_cache.write_slot(slots, new, slot)
         # sample from the TRUE final position, not the padded tail
         h_last = jax.lax.dynamic_slice_in_dim(hidden[0], true_len - 1, 1)
-        tok, _ = self._sample(h_last, key)
-        return slots, tok[0]
+        tok, lp, ok = self._sample(params, h_last, key)
+        return slots, tok[0], lp[0], ok[0]
 
     def _decode_impl(self, params, slots, toks, key):
         """One token for every slot: the model's single-sequence cached
@@ -168,8 +269,8 @@ class ServeEngine:
             return slot_cache.unlift(new), hidden[0, 0]
 
         slots, h = jax.vmap(one)(slots, toks)     # h: (max_slots, d)
-        toks, _ = self._sample(h, key)
-        return slots, toks
+        toks, lp, ok = self._sample(params, h, key)
+        return slots, toks, lp, ok
 
     # --- host side --------------------------------------------------------
     def bucket_for(self, prompt_len: int) -> int:
@@ -193,8 +294,26 @@ class ServeEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
-    def run(self, requests: Iterable[Request],
-            telemetry=None) -> dict:
+    # --- resilience seams -------------------------------------------------
+    def reset(self) -> None:
+        """Warm restart after a contained fault: FRESH slot caches (any
+        poisoned KV dies here), SAME compiled programs — the new cache
+        pytree has identical shapes, so no program retraces and
+        ``decode_compiles`` stays where it was."""
+        self.slots = slot_cache.allocate_slots(self.lm, self.max_slots,
+                                               self.max_len)
+        self.restarts += 1
+
+    def swap_params(self, new_params) -> None:
+        """Hot weight swap between ticks: same tree/shapes/dtypes slide
+        into the already-compiled programs (params are traced arguments,
+        never baked constants), so no recompile happens."""
+        _check_swappable(self.params, new_params)
+        self.params = new_params
+        self.weight_swaps += 1
+
+    def run(self, requests: Iterable[Request], telemetry=None,
+            on_tick: Optional[Callable] = None, admission=None) -> dict:
         """Serve a whole trace; returns ``{"results", "errors", "stats"}``.
 
         ``results`` maps uid -> generated token array; ``stats`` carries
@@ -216,6 +335,13 @@ class ServeEngine:
         request already queued behind it.  (Malformed :class:`Request`
         construction still raises where the request is BUILT — that bug
         belongs to the caller, not the batch.)
+
+        ``on_tick`` receives a :class:`TickReport` after every tick's
+        compute but BEFORE its tokens are recorded — a raising hook
+        discards the tick (the supervisor's containment seam).
+        ``admission`` (:class:`..serve.admission.AdmissionController`)
+        is consulted before each placement; shed requests land in
+        ``errors`` with a ``"shed: ..."`` message.
         """
         sched = SlotScheduler(self.max_slots)
         n_req = 0
@@ -276,6 +402,19 @@ class ServeEngine:
             # admit every arrived request a free slot can take; a row
             # retired below frees its slot for the very next tick's admit
             while True:
+                head = sched.peek(tick)
+                if head is None:
+                    break
+                if admission is not None:
+                    reason = admission.should_shed(
+                        head, sched.queue_depth(tick))
+                    if reason is not None:
+                        shed_req = sched.drop_head(tick)
+                        errors[shed_req.uid] = f"shed: {reason}"
+                        if recorder is not None:
+                            recorder.record("shed", uid=shed_req.uid,
+                                            reason=reason)
+                        continue
                 placed = sched.place(tick)
                 if placed is None:
                     break
@@ -298,7 +437,7 @@ class ServeEngine:
                 padded = np.full(pb, self.pad_fill, np.int32)
                 padded[:len(req.prompt)] = req.prompt
                 t0 = time.perf_counter()
-                self.slots, tok = self._prefill(
+                self.slots, tok, lp, okf = self._prefill(
                     self.params, self.slots, jnp.asarray(padded),
                     np.int32(idx), np.int32(len(req.prompt)),
                     self._next_key())
@@ -316,6 +455,14 @@ class ServeEngine:
                                parent=root_span.get(req.uid),
                                track=f"req{req.uid}", bucket=pb,
                                prompt_len=len(req.prompt))
+                if on_tick is not None:
+                    on_tick(TickReport(
+                        tick=tick, kind="prefill", elapsed_s=now - t0,
+                        emitted=[(req.uid, first)],
+                        finite={req.uid: bool(okf)},
+                        logprob={req.uid: float(lp)},
+                        slots=[idx], engine=self,
+                        queue_depth=sched.queue_depth(tick)))
                 done = sched.record(idx, first, self.eos_id)
                 if done is not None:
                     retire(done, now)
@@ -330,18 +477,34 @@ class ServeEngine:
             occupancy_sum += sched.occupancy
             g_occ.set(sched.occupancy)
             t0 = time.perf_counter()
-            self.slots, out = self._decode(self.params, self.slots,
-                                           jnp.asarray(sched.last_tokens()),
-                                           self._next_key())
+            self.slots, out, lp, okf = self._decode(
+                self.params, self.slots,
+                jnp.asarray(sched.last_tokens()), self._next_key())
             out = np.asarray(out)         # host fetch = device barrier
+            lp, okf = np.asarray(lp), np.asarray(okf)
             now = time.perf_counter()
             t_decode += now - t0
             h_tick.observe(now - t0)
             decode_ticks += 1
             live.sample(sched.queue_depth(tick), sched.occupancy, now)
+            if admission is not None:
+                admission.observe(live, sched.queue_depth(tick), now)
+                admission.apply(self)
             if tracer is not None:
                 tracer.add("decode_tick", t0, now, "engine",
                            track="engine", slots=sched.occupancy)
+            if on_tick is not None:
+                act = sched.active_slots
+                on_tick(TickReport(
+                    tick=tick, kind="decode", elapsed_s=now - t0,
+                    emitted=[(sched.slots[i].request.uid, int(out[i]))
+                             for i in act],
+                    finite={sched.slots[i].request.uid: bool(okf[i])
+                            for i in act},
+                    logprob={sched.slots[i].request.uid: float(lp[i])
+                             for i in act},
+                    slots=list(act), engine=self,
+                    queue_depth=sched.queue_depth(tick)))
             for idx in sched.active_slots:
                 r = sched.slots[idx].request
                 lt = last_tok_wall.get(r.uid)
@@ -390,6 +553,8 @@ class ServeEngine:
             "kv_cache_bytes": self.kv_cache_bytes,
             "prefill_compiles": self._prefill.traces,
             "decode_compiles": self._decode.traces,
+            "restarts": self.restarts,
+            "weight_swaps": self.weight_swaps,
             "buckets": list(self.buckets),
             "latency": latency,
             "window": live.signals(),
@@ -490,6 +655,7 @@ class PagedEngine:
             # 1x for the live slots + 1x retention headroom so the
             # prefix index can keep blocks alive after their request
             num_blocks = 2 * self.max_slots * self.blocks_per_slot
+        self.num_blocks = int(num_blocks)
         self.manager = paged.BlockManager(num_blocks, bs, self.max_slots,
                                           self.blocks_per_slot)
         if donate is None:
@@ -516,12 +682,26 @@ class PagedEngine:
         self.kv_cache_bytes = obs_memory.pytree_bytes(self.pools)
         if draft_layers is not None:
             self.kv_cache_bytes += obs_memory.pytree_bytes(self.draft_pools)
+        self.restarts = 0
+        self.weight_swaps = 0
+        self._spec_enabled = draft_layers is not None
+        self._base_chunks_per_tick = self.chunks_per_tick
+        self._canary: Optional[_CanaryState] = None
 
     # --- compiled programs (each traces exactly once) ---------------------
-    def _sample(self, hidden_last, key):
-        return sample_tokens(self.model, self.params, hidden_last, key,
-                             temperature=self.temperature,
-                             top_k=self.top_k, top_p=self.top_p)
+    def _sample(self, params, hidden_last, key):
+        """Sample plus chosen-token log-prob and per-row finiteness.
+        ``params`` is a traced argument, never a closure capture — the
+        same program therefore serves ANY weights of identical geometry
+        (hot swap, canary) without retracing."""
+        toks, _ = sample_tokens(self.model, params, hidden_last, key,
+                                temperature=self.temperature,
+                                top_k=self.top_k, top_p=self.top_p)
+        nl = self.model.logits_from({"params": params}, hidden_last)
+        lp = jnp.take_along_axis(jax.nn.log_softmax(nl, axis=-1),
+                                 toks[:, None], axis=-1)[:, 0]
+        ok = jnp.isfinite(hidden_last).all(axis=-1)
+        return toks, lp, ok
 
     def _chunk_impl(self, params, pools, tokens, table, pos, logit_idx,
                     wb, wo, key):
@@ -536,8 +716,8 @@ class PagedEngine:
         span = paged.extract_span(new, pos, self.chunk)
         pools = paged.scatter_span(pools, span, wb, wo)
         h_last = jax.lax.dynamic_slice_in_dim(hidden[0], logit_idx, 1)
-        tok, _ = self._sample(h_last, key)
-        return pools, tok[0]
+        tok, lp, ok = self._sample(params, h_last, key)
+        return pools, tok[0], lp[0], ok[0]
 
     def _draft_chunk_impl(self, dparams, dpools, tokens, table, pos,
                           wb, wo):
@@ -565,8 +745,8 @@ class PagedEngine:
         kv = jax.tree_util.tree_map_with_path(
             lambda p, x: x if paged.is_counter(p) else x[:, 0], spans)
         pools = paged.scatter_span(pools, kv, wb, wo)
-        toks, _ = self._sample(h, key)
-        return pools, toks
+        toks, lp, ok = self._sample(params, h, key)
+        return pools, toks, lp, ok
 
     def _draft_impl(self, dparams, dpools, tables, positions, toks,
                     wb, wo):
@@ -612,8 +792,11 @@ class PagedEngine:
 
         h, spans = jax.vmap(one)(tables, positions, toks)
         pools = paged.scatter_span(pools, spans, wb, wo)
-        g, _ = self._sample(h.reshape(-1, h.shape[-1]), jax.random.key(0))
-        return pools, g.reshape(tables.shape[0], T)
+        g, lp, _ = self._sample(params, h.reshape(-1, h.shape[-1]),
+                                jax.random.key(0))
+        ok = jnp.isfinite(h).all(axis=(1, 2))
+        return (pools, g.reshape(tables.shape[0], T),
+                lp.reshape(tables.shape[0], T), ok)
 
     def _copy_impl(self, pools, src, dst):
         return paged.copy_block(pools, src, dst)
@@ -652,6 +835,18 @@ class PagedEngine:
                 f"request {req.uid}: prompt {len(req.prompt)} + "
                 f"{req.max_new_tokens} new tokens exceeds the serving "
                 f"capacity max_len={self.max_len}")
+        # worst-case block need (zero prefix sharing) must fit the pool
+        # — checked at SUBMIT so one impossible request lands in
+        # ``errors`` instead of raising BlockPoolExhausted mid-run and
+        # taking the whole batch with it (the v1/paged error-contract
+        # unification the supervisor relies on)
+        worst = -(-self._capacity_len(req) // self.block_size)
+        if worst > self.num_blocks:
+            raise ValueError(
+                f"request {req.uid}: needs up to {worst} KV blocks "
+                f"({self._capacity_len(req)} positions at block size "
+                f"{self.block_size}) but the pool holds only "
+                f"{self.num_blocks}")
 
     def _capacity_len(self, req: Request) -> int:
         """Stream positions a request may ever write — its whole block
@@ -667,8 +862,126 @@ class PagedEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    # --- resilience seams -------------------------------------------------
+    def reset(self) -> None:
+        """Warm restart after a contained fault: fresh block pools and
+        a fresh block manager (so poisoned KV AND the prefix index that
+        could resurrect it both die), SAME compiled programs — the new
+        pools have identical shapes, so nothing retraces and
+        ``decode_compiles`` stays put."""
+        self._canary = None
+        self.manager = paged.BlockManager(self.num_blocks, self.block_size,
+                                          self.max_slots,
+                                          self.blocks_per_slot)
+        self.pools = paged.build_pools(self.lm, self.num_blocks + 1,
+                                       self.block_size, self.padded_len)
+        if self.draft_layers is not None:
+            self.draft_pools = paged.build_pools(
+                self.draft_lm, self.num_blocks + 1, self.block_size,
+                self.padded_len)
+        self.restarts += 1
+
+    def swap_params(self, new_params) -> None:
+        """Hot weight swap between ticks: geometry-checked params slide
+        into the compiled programs (traced arguments, not baked
+        constants) — no recompile.  The prefix index is flushed: its KV
+        was computed under the old weights, and matching it under the
+        new ones would mix generations.  Draft params re-derive from
+        the new target (they share weights by construction)."""
+        _check_swappable(self.params, new_params)
+        self.params = new_params
+        if self.draft_layers is not None:
+            self.draft_lm, self.draft_params = spec_mod.truncated_draft(
+                self.lm, new_params, self.draft_layers)
+        self.manager.flush_index()
+        self.weight_swaps += 1
+
+    def set_spec_enabled(self, enabled: bool) -> bool:
+        """Toggle speculative decoding at runtime (admission control's
+        first degradation step).  Returns the effective state; always
+        False when the engine has no draft.  Greedy OUTPUTS are
+        unaffected either way — disabling only changes the forward
+        count, and re-enabling after a gap merely costs acceptance
+        (the draft's cache has holes; verification stays exact)."""
+        if self.draft_layers is None:
+            return False
+        self._spec_enabled = bool(enabled)
+        return self._spec_enabled
+
+    def begin_canary(self, new_params, slots: Iterable[int],
+                     observe: Optional[Callable] = None) -> None:
+        """Route ``slots`` to candidate weights while everyone else
+        stays on the stable ones — one extra call of the SAME compiled
+        decode program per tick, old/new KV writes cross-routed to the
+        trash block so neither generation's cache sees the other's."""
+        if self._canary is not None:
+            raise RuntimeError("a canary is already active")
+        if self.draft_layers is not None:
+            raise RuntimeError(
+                "canary mode requires a non-speculative engine (the "
+                "draft's shared cache cannot serve two weight sets)")
+        _check_swappable(self.params, new_params)
+        sl = frozenset(int(s) for s in slots)
+        if not sl or not all(0 <= s < self.max_slots for s in sl):
+            raise ValueError(f"canary slots {sorted(sl)} must be a "
+                             f"non-empty subset of 0..{self.max_slots - 1}")
+        if len(sl) >= self.max_slots:
+            raise ValueError("canary cannot take every slot (no stable "
+                             "traffic left to compare against)")
+        self._canary = _CanaryState(params=new_params, slots=sl,
+                                    observe=observe)
+
+    def end_canary(self, promote: bool) -> dict:
+        """Finish the canary: promote swaps the candidate in for ALL
+        slots (prefix index flushed); rollback just drops it.  Either
+        way returns the engine-side comparison summary."""
+        if self._canary is None:
+            raise RuntimeError("no canary is active")
+        can, self._canary = self._canary, None
+        if promote:
+            self.swap_params(can.params)
+        return can.summary()
+
+    def _canary_decode(self, mgr, pos, toks, wb, wo, dec):
+        """One decode tick under an active canary: two calls of the one
+        compiled program.  Call A (stable params) trashes canary slots'
+        KV writes; call B (candidate params) trashes everyone else's —
+        each weight set's cache stays self-consistent.  Tokens merge
+        per slot; canary slots contribute agreement/drift samples."""
+        can = self._canary
+        wb_old, wb_new = wb.copy(), wb.copy()
+        for i in range(self.max_slots):
+            if i in can.slots:
+                wb_old[i] = paged.TRASH
+            else:
+                wb_new[i] = paged.TRASH
+        tables_dev = jnp.asarray(mgr.tables)
+        pos_dev, toks_dev = jnp.asarray(pos), jnp.asarray(toks)
+        wo_dev = jnp.asarray(wo)
+        key = self._next_key()
+        self.pools, out_o, lp_o, ok_o = self._decode(
+            self.params, self.pools, tables_dev, pos_dev, toks_dev,
+            jnp.asarray(wb_old), wo_dev, key)
+        self.pools, out_n, lp_n, ok_n = self._decode(
+            can.params, self.pools, tables_dev, pos_dev, toks_dev,
+            jnp.asarray(wb_new), wo_dev, key)
+        out_o, lp_o, ok_o = (np.asarray(x) for x in (out_o, lp_o, ok_o))
+        out_n, lp_n, ok_n = (np.asarray(x) for x in (out_n, lp_n, ok_n))
+        now = time.perf_counter()
+        out, lp, ok = out_o.copy(), lp_o.copy(), ok_o.copy()
+        for i in can.slots:
+            out[i], lp[i], ok[i] = out_n[i], lp_n[i], ok_n[i]
+        for i in dec:
+            if i in can.slots:
+                drift = abs(float(lp_n[i]) - float(lp_o[i]))
+                can.note(agree=int(out_o[i]) == int(out_n[i]),
+                         drift=drift if np.isfinite(drift) else np.inf,
+                         finite=bool(ok_n[i]), now=now)
+        return out, lp, ok
+
     def run(self, requests: Iterable[Request], telemetry=None,
-            keep_timeline: bool = False) -> dict:
+            keep_timeline: bool = False, on_tick: Optional[Callable] = None,
+            admission=None) -> dict:
         """Serve a trace; returns ``{"results", "errors", "stats"}``
         (plus ``"timeline"`` when ``keep_timeline`` — one dict per tick
         with ``placed``/``chunks``/``decoded`` uid lists, the record the
@@ -830,7 +1143,7 @@ class PagedEngine:
             wb_dev, wo_dev = jnp.asarray(wb), jnp.asarray(wo)
             pos = np.int32(plan.feed_start)
             t0 = time.perf_counter()
-            self.pools, tok = self._chunk_prog(
+            self.pools, tok, c_lp, c_ok = self._chunk_prog(
                 self.params, self.pools, toks_dev, table_dev, pos,
                 np.int32(max(plan.logit_index, 0)), wb_dev, wo_dev,
                 self._next_key())
@@ -859,6 +1172,14 @@ class PagedEngine:
                                parent=rid, track=f"req{req.uid}",
                                feed_start=plan.feed_start,
                                commit_to=plan.commit_to, is_last=True)
+                if on_tick is not None:
+                    on_tick(TickReport(
+                        tick=tick, kind="prefill", elapsed_s=now - t0,
+                        emitted=[(req.uid, first)],
+                        finite={req.uid: bool(c_ok)},
+                        logprob={req.uid: float(c_lp)},
+                        slots=[idx], engine=self,
+                        queue_depth=sched.queue_depth(tick)))
                 stream[idx].append(first)
                 emit(idx, first, now)
             else:
@@ -877,14 +1198,28 @@ class PagedEngine:
             sched.mark_arrivals(tick, time.perf_counter())
             g_queue.set(sched.queue_depth(tick))
             ev = ({"tick": tick, "placed": [], "chunks": [],
-                   "decoded": []} if keep_timeline else None)
+                   "decoded": [], "shed": []} if keep_timeline else None)
 
             # admission: FIFO while a slot AND its whole block budget
-            # are available (no partial admission, no pool deadlock)
+            # are available (no partial admission, no pool deadlock);
+            # an AdmissionController may shed the head first — placed
+            # slots are never touched, so shedding cannot starve them
             while sched.occupancy < self.max_slots:
                 head = sched.peek(tick)
                 if head is None:
                     break
+                if admission is not None:
+                    reason = admission.should_shed(
+                        head, sched.queue_depth(tick))
+                    if reason is not None:
+                        shed_req = sched.drop_head(tick)
+                        errors[shed_req.uid] = f"shed: {reason}"
+                        if ev is not None:
+                            ev["shed"].append(shed_req.uid)
+                        if recorder is not None:
+                            recorder.record("shed", uid=shed_req.uid,
+                                            reason=reason)
+                        continue
                 t_adm = time.perf_counter()
                 sp = mgr.match_prefix(head.prompt)
                 if not mgr.can_admit(sp, self._capacity_len(head)):
@@ -947,7 +1282,9 @@ class PagedEngine:
             # much prefill work is queued — the stall bound
             dec = sched.decoding_slots()
             if dec:
-                if self.draft_layers is None:
+                use_spec = (self.draft_layers is not None
+                            and self._spec_enabled)
+                if not use_spec:
                     toks = np.zeros(self.max_slots, np.int32)
                     pos = np.zeros(self.max_slots, np.int32)
                     wb = np.full(self.max_slots, paged.TRASH, np.int32)
@@ -960,12 +1297,17 @@ class PagedEngine:
                         wb[i] = mgr.tables[i, c // bs]
                         wo[i] = c % bs
                     t0 = time.perf_counter()
-                    self.pools, out = self._decode(
-                        self.params, self.pools, jnp.asarray(mgr.tables),
-                        jnp.asarray(pos), jnp.asarray(toks),
-                        jnp.asarray(wb), jnp.asarray(wo),
-                        self._next_key())
-                    out = np.asarray(out)   # host fetch = device barrier
+                    if self._canary is not None:
+                        out, lp_h, ok_h = self._canary_decode(
+                            mgr, pos, toks, wb, wo, dec)
+                    else:
+                        self.pools, out, lp_h, ok_h = self._decode(
+                            self.params, self.pools,
+                            jnp.asarray(mgr.tables), jnp.asarray(pos),
+                            jnp.asarray(toks), jnp.asarray(wb),
+                            jnp.asarray(wo), self._next_key())
+                        out = np.asarray(out)   # host fetch = barrier
+                        lp_h, ok_h = np.asarray(lp_h), np.asarray(ok_h)
                     now = time.perf_counter()
                     t_decode += now - t0
                     h_tick.observe(now - t0)
@@ -973,6 +1315,17 @@ class PagedEngine:
                     if tracer is not None:
                         tracer.add("decode_tick", t0, now, "engine",
                                    track="engine", slots=len(dec))
+                    if on_tick is not None:
+                        on_tick(TickReport(
+                            tick=tick, kind="decode", elapsed_s=now - t0,
+                            emitted=[(sched.slots[i].request.uid,
+                                      int(out[i])) for i in dec],
+                            finite={sched.slots[i].request.uid:
+                                    bool(ok_h[i]) for i in dec},
+                            logprob={sched.slots[i].request.uid:
+                                     float(lp_h[i]) for i in dec},
+                            slots=list(dec), engine=self,
+                            queue_depth=sched.queue_depth(tick)))
                     for i in dec:
                         tok = int(out[i])
                         committed[i] += 1
@@ -1013,10 +1366,11 @@ class PagedEngine:
                     props = np.asarray(props)
                     verify_toks = np.concatenate(
                         [toks[:, None], props], axis=1).astype(np.int32)
-                    self.pools, g = self._verify(
+                    self.pools, g, v_lp, v_ok = self._verify(
                         self.params, self.pools, tables_dev, pos_dev,
                         jnp.asarray(verify_toks), wb_dev, wo_dev)
                     g = np.asarray(g)       # host fetch = device barrier
+                    v_lp, v_ok = np.asarray(v_lp), np.asarray(v_ok)
                     now = time.perf_counter()
                     t_decode += now - t0
                     h_tick.observe(now - t0)
@@ -1026,9 +1380,24 @@ class PagedEngine:
                         tracer.add("decode_tick", t0, now, "engine",
                                    track="engine", slots=len(dec),
                                    speculative=True)
+                    # acceptance decided BEFORE any state mutates, so
+                    # the tick report (and a hook that rejects it) sees
+                    # exactly what would be committed
+                    acc = {i: spec_mod.greedy_accept(props[i], g[i])
+                           for i in dec}
+                    if on_tick is not None:
+                        on_tick(TickReport(
+                            tick=tick, kind="decode", elapsed_s=now - t0,
+                            emitted=[(sched.slots[i].request.uid, int(t))
+                                     for i in dec for t in acc[i][1]],
+                            finite={sched.slots[i].request.uid:
+                                    bool(v_ok[i]) for i in dec},
+                            logprob={sched.slots[i].request.uid:
+                                     float(v_lp[i, 0]) for i in dec},
+                            slots=list(dec), engine=self,
+                            queue_depth=sched.queue_depth(tick)))
                     for i in dec:
-                        a, emitted = spec_mod.greedy_accept(props[i],
-                                                            g[i])
+                        a, emitted = acc[i]
                         proposed_total += k
                         accepted_total += a
                         h_accept.observe(a / k if k else 0.0)
@@ -1052,6 +1421,9 @@ class PagedEngine:
                                                    committed[i])
             noww = time.perf_counter()
             live.sample(sched.queue_depth(tick), sched.occupancy, noww)
+            if admission is not None:
+                admission.observe(live, sched.queue_depth(tick), noww)
+                admission.apply(self)
             if telemetry is not None and noww - last_window_emit >= 1.0:
                 last_window_emit = noww
                 telemetry.writer.emit("obs_window", scope="serve",
@@ -1078,6 +1450,7 @@ class PagedEngine:
         }
         spec_stats = {
             "enabled": self.draft_layers is not None,
+            "active_at_end": self._spec_enabled,
             "k": self.spec_k if self.draft_layers is not None else 0,
             "draft_layers": self.draft_layers,
             "rounds": spec_rounds,
@@ -1106,6 +1479,8 @@ class PagedEngine:
             "chunk_compiles": self._chunk_prog.traces,
             "decode_compiles": self._decode.traces,
             "copy_compiles": self._copy.traces,
+            "restarts": self.restarts,
+            "weight_swaps": self.weight_swaps,
             "verify_compiles": self._verify.traces
             if self.draft_layers is not None else 0,
             "draft_compiles": self._draft.traces

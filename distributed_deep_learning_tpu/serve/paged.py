@@ -307,6 +307,23 @@ class BlockManager:
             self.on_event("evict", freed=freed, need=need)
         return freed
 
+    def flush_index(self) -> int:
+        """Drop EVERY prefix-index entry and the reference each holds.
+
+        Blocks still owned by live slots stay alive (the slots' own
+        refs remain); blocks the index alone retained return to the
+        free list.  Hot weight swap calls this: indexed KV was computed
+        under the OLD weights, so matching it as a prefix under the new
+        weights would silently mix generations."""
+        dropped = 0
+        for h in list(self.index.entries):
+            b = self.index.remove(h)
+            self._deref(b)
+            dropped += 1
+        if dropped and self.on_event is not None:
+            self.on_event("index_flush", dropped=dropped)
+        return dropped
+
     # --- prefix matching --------------------------------------------------
     def match_prefix(self, prompt: np.ndarray) -> SharedPrefix:
         """Longest reusable prefix of ``prompt`` present in the index:

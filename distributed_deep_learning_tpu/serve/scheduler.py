@@ -38,6 +38,10 @@ class Request:
     # The engine measures, serve/load.py:slo_report scores attainment.
     slo_ttft_ms: Optional[float] = None
     slo_e2e_ms: Optional[float] = None
+    # admission priority class: 0 = interactive (never shed), larger =
+    # more sheddable (serve/admission.py sheds classes >= its floor
+    # under overload).  The default 1 is "normal" traffic.
+    priority: int = 1
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt)
@@ -53,6 +57,9 @@ class Request:
             if v is not None and v <= 0:
                 raise ValueError(f"request {self.uid}: {name} must be "
                                  f"positive, got {v}")
+        if not isinstance(self.priority, int) or self.priority < 0:
+            raise ValueError(f"request {self.uid}: priority must be a "
+                             f"non-negative int, got {self.priority!r}")
 
     @property
     def trace_id(self) -> str:
@@ -127,6 +134,23 @@ class SlotScheduler:
         return depth
 
     # --- placement / retirement ------------------------------------------
+    def peek(self, tick: int) -> Optional[Request]:
+        """The request :meth:`place` would pop next, if one has arrived
+        — lets the engine test admission (block-pool budget, load shed)
+        BEFORE committing a slot to it."""
+        if self._queue and self._queue[0].arrival_tick <= tick:
+            return self._queue[0]
+        return None
+
+    def drop_head(self, tick: int) -> Optional[Request]:
+        """Pop and return the arrived head WITHOUT placing it — the
+        shed path of admission control.  The caller owns reporting the
+        drop (the engine records it in ``errors``); placed slots are
+        never touched, so shedding cannot starve an admitted request."""
+        if self._queue and self._queue[0].arrival_tick <= tick:
+            return self._queue.pop(0)
+        return None
+
     def place(self, tick: int) -> Optional[tuple[int, Request]]:
         """Pop the next ARRIVED request into the lowest free slot, or
         None when no slot is free / nothing has arrived yet."""
@@ -190,15 +214,6 @@ class PagedScheduler(SlotScheduler):
         super().__init__(max_slots)
         self.prefilling: dict[int, int] = {}   # slot -> chunks remaining
         self._turn = 0
-
-    def peek(self, tick: int) -> Optional[Request]:
-        """The request :meth:`place` would pop next, if one has arrived
-        — lets the engine test block-pool admission BEFORE committing a
-        slot to it (admission reserves a request's whole KV budget up
-        front, which is what makes the pool deadlock-free)."""
-        if self._queue and self._queue[0].arrival_tick <= tick:
-            return self._queue[0]
-        return None
 
     def begin_prefill(self, idx: int, n_chunks: int) -> None:
         if n_chunks < 1:

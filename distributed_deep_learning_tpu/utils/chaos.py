@@ -40,6 +40,11 @@ import numpy as np
 KINDS = ("nan_batch", "grad_spike", "worker_failure", "stale_heartbeat")
 INJECTOR_KINDS = ("ckpt_truncate", "ckpt_bitflip", "fs_error",
                   "shrink_topology")
+#: serve-side in-band kinds, fired by :meth:`ChaosPlan.serve_hook` from
+#: inside the supervisor's tick watchdog (``step`` means decode TICK
+#: here, not train step)
+SERVE_KINDS = ("nan_logits", "stalled_tick", "corrupt_block",
+               "engine_crash", "slow_tick")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,9 +63,10 @@ class ChaosEvent:
     target: str | int | None = None
 
     def __post_init__(self):
-        if self.kind not in KINDS:
+        if self.kind not in KINDS + SERVE_KINDS:
             raise ValueError(f"chaos event kind {self.kind!r}: in-band "
-                             f"kinds are {KINDS} (use the static "
+                             f"kinds are {KINDS} (train) and "
+                             f"{SERVE_KINDS} (serve; use the static "
                              f"injectors for {INJECTOR_KINDS})")
         if self.step < 1:
             raise ValueError(f"chaos event step must be >= 1, got "
@@ -159,6 +165,88 @@ class ChaosPlan:
         return jax.device_put(xh, sharding) if sharding is not None \
             else xh
 
+    # -- serve-side in-band hook (supervisor tick watchdog) ------------------
+    def serve_hook(self, engine, report) -> None:
+        """Apply every due serve fault at this tick; may raise.
+
+        Called by :meth:`..serve.supervisor.ServeSupervisor._on_tick`
+        with the engine and its :class:`..serve.engine.TickReport`,
+        AFTER the tick's compute but before its tokens commit.  An
+        event is due once ``report.tick`` reaches its ``step`` (ticks
+        are not dense in ``step`` the way train steps are — prefill
+        and decode share the counter); KV-poison kinds additionally
+        wait for a live slot to poison.  One-shot like
+        :meth:`batch_hook`, and for the same reason: the supervisor's
+        replay after containment must not re-inject the fault it is
+        recovering from."""
+        for i, ev in enumerate(self.events):
+            if (i in self._done or ev.kind not in SERVE_KINDS
+                    or ev.step > report.tick):
+                continue
+            if (ev.kind in ("nan_logits", "corrupt_block")
+                    and not report.slots):
+                continue  # defer until there is a live slot to poison
+            self._done.add(i)
+            self.fired.append((report.tick, ev.kind))
+            if self.recorder is not None:
+                self.recorder.record("chaos_fired", step=report.tick,
+                                     fault=ev.kind)
+            if ev.kind == "engine_crash":
+                from distributed_deep_learning_tpu.serve.supervisor import (
+                    EngineCrash)
+
+                raise EngineCrash(
+                    f"injected engine crash at tick {report.tick}")
+            if ev.kind in ("stalled_tick", "slow_tick"):
+                time.sleep(ev.magnitude
+                           or (0.25 if ev.kind == "stalled_tick"
+                               else 0.02))
+                continue
+            slot = (int(ev.target) if ev.target is not None
+                    else int(self._rng(ev).choice(sorted(report.slots))))
+            self._poison_kv(engine, slot,
+                            np.nan if ev.kind == "nan_logits" else np.inf,
+                            first_block_only=ev.kind == "corrupt_block")
+
+    @staticmethod
+    def _poison_kv(engine, slot: int, value: float,
+                   first_block_only: bool = False) -> None:
+        """Overwrite `slot`'s committed KV with `value` — the serve
+        analogue of :meth:`_poison`: the NEXT tick's attention over the
+        poisoned window yields non-finite hidden states, which the
+        device-computed finiteness flags surface to the watchdog."""
+        import jax
+        import jax.numpy as jnp
+
+        from distributed_deep_learning_tpu.serve import paged
+
+        mgr = getattr(engine, "manager", None)
+        if mgr is not None:                      # PagedEngine: block pools
+            blocks = [int(b) for b in mgr.tables[slot]
+                      if int(b) != paged.TRASH]
+            if first_block_only:
+                blocks = blocks[:1]
+            if not blocks:
+                return
+            idx = jnp.asarray(blocks)
+
+            def poison(leaf):
+                if leaf.ndim < 2 or not jnp.issubdtype(leaf.dtype,
+                                                       jnp.inexact):
+                    return leaf
+                return leaf.at[idx].set(value)
+
+            engine.pools = jax.tree.map(poison, engine.pools)
+            return
+
+        def poison(leaf):                        # ServeEngine: slot table
+            if leaf.ndim < 2 or not jnp.issubdtype(leaf.dtype,
+                                                   jnp.inexact):
+                return leaf
+            return leaf.at[slot].set(value)
+
+        engine.slots = jax.tree.map(poison, engine.slots)
+
     # -- out-of-band injectors ---------------------------------------------
     @staticmethod
     def _step_files(ckpt_dir: str, step: int) -> list[str]:
@@ -216,6 +304,22 @@ class ChaosPlan:
             f.seek(offset)
             f.write(bytes([byte ^ (1 << bit)]))
         return target
+
+    @staticmethod
+    def bitflip_file(path: str, seed: int = 0) -> str:
+        """Flip one seeded bit in an arbitrary file (the published-
+        weights analogue of :meth:`bitflip_checkpoint` — size unchanged,
+        only the integrity manifest's checksums can catch it)."""
+        size = os.path.getsize(path)
+        rng = np.random.default_rng((seed, size))
+        offset = int(rng.integers(0, size))
+        bit = int(rng.integers(0, 8))
+        with open(path, "r+b") as f:
+            f.seek(offset)
+            byte = f.read(1)[0]
+            f.seek(offset)
+            f.write(bytes([byte ^ (1 << bit)]))
+        return path
 
     @staticmethod
     def stale_heartbeat(hb_dir: str, rank: int, age: float = 3600.0) -> None:
@@ -473,3 +577,224 @@ def run_blackbox_drill(seed: int = 0,
         "events_captured": doc["captured"],
         "faults_fired": list(plan.fired),
     }
+
+
+def run_serve_resilience_drill(seed: int = 0) -> dict:
+    """Exercise the serve-side self-healing chain end to end; return the
+    ``serve_resilience`` record ``bench.py`` reports.
+
+    ONE small :class:`..serve.engine.PagedEngine` survives the whole
+    gauntlet — every scenario warm-restarts it (``reset()``) rather than
+    rebuilding, so the record's ``decode_compiles`` staying at 1 is
+    itself evidence that containment, weight swap and canary all reuse
+    the compiled programs.  Sections:
+
+    1. **clean** — the unsupervised reference outputs every fault
+       scenario must reproduce bit-identically.
+    2. **faults** — ``engine_crash`` / ``nan_logits`` /
+       ``corrupt_block`` / ``stalled_tick`` injected mid-decode under
+       :class:`..serve.supervisor.ServeSupervisor`: detection latency
+       in ticks, recovery wall seconds, ``requests_lost == 0`` and
+       bit-identical results per scenario.
+    3. **slo** — ``slow_tick`` bursts under a 400 ms e2e SLO with
+       :class:`..serve.admission.AdmissionController` active: SLO
+       attainment faulted vs clean.
+    4. **swap** — the hot-reload gauntlet through
+       :mod:`..serve.reload`: publish identical weights → canary →
+       PROMOTE; publish zeroed weights → canary → ROLLBACK (replayed
+       outputs bit-identical); publish then bit-flip → manifest
+       REJECT + quarantine, with a torn (manifest-less) publish
+       invisible to the watcher throughout.
+    """
+    import tempfile
+
+    import jax
+
+    from distributed_deep_learning_tpu.serve import reload as reload_mod
+    from distributed_deep_learning_tpu.serve.admission import (
+        AdmissionController)
+    from distributed_deep_learning_tpu.serve.bench import (build_model,
+                                                           make_trace,
+                                                           paged_max_len)
+    from distributed_deep_learning_tpu.serve.engine import PagedEngine
+    from distributed_deep_learning_tpu.serve.scheduler import Request
+    from distributed_deep_learning_tpu.serve.supervisor import ServeSupervisor
+
+    model_kw = dict(vocab_size=128, num_layers=1, d_model=64, num_heads=2,
+                    mlp_dim=128, max_len=96)
+    model, params = build_model(seed, **model_kw)
+    cap = paged_max_len(model.max_len, 8, False, 0)
+    eng = PagedEngine(model, params, max_slots=4, max_len=cap,
+                      kv_block_size=8, prefill_chunk=16)
+    trace = make_trace(8, vocab_size=model.vocab_size, seed=seed,
+                       prompt_lens=(4, 12), new_tokens=(6, 14))
+
+    def supervised(chaos=None, **kw):
+        sup = ServeSupervisor(eng, chaos=chaos, **kw)
+        return sup.run(list(trace)), sup
+
+    ref, _ = supervised()
+    if ref["errors"] or len(ref["results"]) != len(trace):
+        raise RuntimeError(f"reference run incomplete: "
+                           f"{len(ref['results'])}/{len(trace)} results, "
+                           f"errors {ref['errors']}")
+
+    def identical(out):
+        return (set(out["results"]) == set(ref["results"]) and all(
+            np.array_equal(out["results"][u], ref["results"][u])
+            for u in ref["results"]))
+
+    record: dict = {
+        "metric": ("serve self-healing: detection ticks / recovery "
+                   "seconds / requests lost / SLO under faults"),
+        "model": model_kw, "requests": len(trace), "scenarios": {},
+    }
+    detect, recover = [], []
+    lost_total = 0
+    all_ok = True
+
+    # --- 2. fault scenarios: inject mid-decode, demand bit-identity -------
+    cases = {
+        "engine_crash": ([ChaosEvent(step=5, kind="engine_crash")], {}),
+        "nan_logits": ([ChaosEvent(step=5, kind="nan_logits")], {}),
+        "corrupt_block": ([ChaosEvent(step=5, kind="corrupt_block")], {}),
+        "stalled_tick": ([ChaosEvent(step=5, kind="stalled_tick",
+                                     magnitude=0.3)],
+                         dict(stall_timeout_s=0.1)),
+    }
+    for name, (events, sup_kw) in cases.items():
+        plan = ChaosPlan(events, seed=seed)
+        out, _ = supervised(chaos=plan, **sup_kw)
+        st = out["stats"]
+        fired_tick = plan.fired[0][0] if plan.fired else None
+        fault = st["faults"][0] if st["faults"] else None
+        det = (fault["tick"] - fired_tick
+               if fault and fired_tick is not None
+               and fault["tick"] is not None else None)
+        same = identical(out)
+        ok = (same and st["requests_lost"] == 0 and not out["errors"]
+              and st["restarts"] == 1 and det is not None)
+        record["scenarios"][name] = {
+            "fired": list(plan.fired),
+            "detection_ticks": det,
+            "recovery_s": (round(fault["recovery_s"], 3)
+                           if fault else None),
+            "restarts": st["restarts"],
+            "requests_lost": st["requests_lost"],
+            "bit_identical": same,
+            "passed": ok,
+        }
+        all_ok = all_ok and ok
+        lost_total += st["requests_lost"]
+        if det is not None:
+            detect.append(det)
+        if fault is not None:
+            recover.append(fault["recovery_s"])
+
+    # --- 3. SLO under slow ticks, admission active -------------------------
+    slo_trace = [Request(r.uid, r.prompt, r.max_new_tokens,
+                         arrival_tick=r.arrival_tick,
+                         slo_ttft_ms=1000.0, slo_e2e_ms=400.0)
+                 for r in trace]
+
+    def slo_run(chaos=None):
+        adm = AdmissionController(itl_p99_ms=30.0, max_queue_depth=32,
+                                  patience=2, cool=4)
+        sup = ServeSupervisor(eng, chaos=chaos, admission=adm)
+        return sup.run(list(slo_trace))["stats"]
+
+    clean = slo_run()
+    slow = ChaosPlan([ChaosEvent(step=s, kind="slow_tick", magnitude=0.12)
+                      for s in range(4, 8)], seed=seed)
+    faulted = slo_run(slow)
+    eng.chunks_per_tick = eng._base_chunks_per_tick  # undo degradation
+    record["slo_attainment_clean"] = clean["engine"]["slo"][
+        "slo_attainment"]
+    record["slo_attainment_faulted"] = faulted["engine"]["slo"][
+        "slo_attainment"]
+    record["slo_degradation_level_changes"] = faulted["admission"][
+        "level_changes"]
+    lost_total += clean["requests_lost"] + faulted["requests_lost"]
+
+    # --- 4. hot-swap gauntlet: promote / rollback / reject -----------------
+    swap: dict = {}
+    rm_kw = dict(canary_slots=2, canary_ticks=2, min_compare=4,
+                 min_acceptance=0.7, max_drift_p99=2.0)
+    consumed: set = set()
+
+    def manager(d):
+        rm = reload_mod.ReloadManager(d, **rm_kw)
+        rm.watcher.seen |= consumed
+        return rm
+
+    host_params = jax.device_get(params)
+    with tempfile.TemporaryDirectory() as d:
+        reload_mod.publish_weights(d, 1, host_params)
+        rm = manager(d)
+        out, _ = supervised(reload=rm)
+        consumed.add(1)
+        swap["promote"] = {
+            "swaps": rm.swaps, "rollbacks": rm.rollbacks,
+            "bit_identical": identical(out),
+            "passed": (rm.swaps == 1 and rm.rollbacks == 0
+                       and identical(out)
+                       and out["stats"]["requests_lost"] == 0),
+        }
+
+        bad = jax.tree.map(np.zeros_like, host_params)
+        reload_mod.publish_weights(d, 2, bad)
+        rm = manager(d)
+        out, _ = supervised(reload=rm)
+        consumed.add(2)
+        swap["rollback"] = {
+            "swaps": rm.swaps, "rollbacks": rm.rollbacks,
+            "restarts": out["stats"]["restarts"],
+            "requests_lost": out["stats"]["requests_lost"],
+            "bit_identical": identical(out),
+            "passed": (rm.swaps == 0 and rm.rollbacks == 1
+                       and out["stats"]["restarts"] == 1
+                       and identical(out)
+                       and out["stats"]["requests_lost"] == 0),
+        }
+        recover.extend(f["recovery_s"] for f in out["stats"]["faults"])
+
+        reload_mod.publish_weights(d, 3, host_params)
+        ChaosPlan.bitflip_file(reload_mod._weights_path(d, 3), seed=seed)
+        # a torn publish (payload, no manifest) must stay invisible
+        np.savez(os.path.join(d, "weights-00000004.npz"),
+                 leaf_00000=np.zeros(1))
+        rm = manager(d)
+        out, _ = supervised(reload=rm)
+        consumed.add(3)
+        qdir = os.path.join(d, "quarantine")
+        quarantined = sorted(os.listdir(qdir)) if os.path.isdir(qdir) \
+            else []
+        swap["reject"] = {
+            "rejected": rm.rejected, "swaps": rm.swaps,
+            "bit_identical": identical(out),
+            "torn_publish_invisible":
+                reload_mod.latest_published(d) == 1,
+            "quarantined": quarantined,
+            "passed": (rm.rejected == 1 and rm.swaps == 0
+                       and identical(out)
+                       and reload_mod.latest_published(d) == 1
+                       and any(n.startswith("weights-00000003")
+                               for n in quarantined)),
+        }
+        final_stats = out["stats"]["engine"]
+
+    lost_total += sum(0 for _ in ())  # swap scenarios asserted above
+    all_ok = all_ok and all(s["passed"] for s in swap.values())
+    record["swap"] = swap
+    record["detection_ticks_max"] = max(detect) if detect else None
+    record["recovery_seconds_max"] = (round(max(recover), 3)
+                                      if recover else None)
+    record["requests_lost_total"] = lost_total
+    record["decode_compiles"] = final_stats["decode_compiles"]
+    record["chunk_compiles"] = final_stats["chunk_compiles"]
+    record["drill_passed"] = bool(
+        all_ok and lost_total == 0
+        and final_stats["decode_compiles"] == 1
+        and record["slo_attainment_clean"]
+        >= record["slo_attainment_faulted"])
+    return record

@@ -188,6 +188,20 @@ class Config:
     spec_k: int = 4                     # serving: draft tokens per round
     slo_ttft_ms: float | None = None    # serving: per-request TTFT SLO
     slo_e2e_ms: float | None = None     # serving: per-request e2e SLO
+    serve_deadline_ms: float | None = None  # supervised serving: hard
+                                        #   per-request wall deadline
+                                        #   (serve/supervisor.py)
+    serve_retries: int = 2              # supervised serving: engine-fault
+                                        #   survivals allowed per request
+    reload_watch: str | None = None     # supervised serving: hot weight-
+                                        #   reload watch directory
+                                        #   (serve/reload.py)
+    canary_slots: int = 2               # supervised serving: slots routed
+                                        #   to candidate weights before
+                                        #   promote/rollback
+    admission: dict | None = None       # supervised serving: admission-
+                                        #   control knobs (--admission
+                                        #   "depth=16,itl-p99-ms=200")
     pos_embedding: str = "learned"      # learned | rope (gpt)
     num_kv_heads: int | None = None     # grouped-query attention (gpt)
     label_smoothing: float = 0.0        # token-CE smoothing (LM families)
@@ -453,6 +467,37 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
     p.add_argument("--slo-e2e-ms", dest="slo_e2e_ms", type=float,
                    default=None, metavar="MS",
                    help="serving: per-request end-to-end latency SLO")
+    p.add_argument("--serve-deadline-ms", dest="serve_deadline_ms",
+                   type=float, default=None, metavar="MS",
+                   help="supervised serving: hard per-request wall "
+                        "deadline — a request a fault loop holds past "
+                        "this errors out instead of replaying forever "
+                        "(serve/supervisor.py; implies supervision)")
+    p.add_argument("--serve-retries", dest="serve_retries", type=int,
+                   default=2, metavar="N",
+                   help="supervised serving: engine faults a request may "
+                        "survive (with zero-loss replay) before it is "
+                        "errored out")
+    p.add_argument("--reload-watch", dest="reload_watch", type=str,
+                   default=None, metavar="DIR",
+                   help="supervised serving: watch DIR for atomically "
+                        "published weights (serve/reload.py) and hot-swap "
+                        "them between ticks — canary first, integrity-"
+                        "manifest verified, corrupt saves quarantined")
+    p.add_argument("--canary-slots", dest="canary_slots", type=int,
+                   default=2, metavar="N",
+                   help="supervised serving: decode slots routed to "
+                        "candidate weights while old/new agreement and "
+                        "logprob drift decide promote vs rollback "
+                        "(0 = swap verified weights directly)")
+    p.add_argument("--admission", type=str, default=None,
+                   metavar="K=V,...",
+                   help="supervised serving: SLO-aware admission control "
+                        "— 'depth=16,itl-p99-ms=200,shed-priority=2' "
+                        "(keys: depth, itl-p99-ms, shed-priority, "
+                        "patience, cool); degrades quality (spec decode "
+                        "off, chunk budget down) before shedding, and "
+                        "never sheds priority-0 requests")
     p.add_argument("--schedule", dest="lr_schedule",
                    choices=["none", "cosine", "rsqrt", "step"],
                    default="none",
@@ -580,6 +625,47 @@ def parse_buckets_arg(text: str | None) -> tuple[int, ...] | None:
             raise SystemExit(f"--prefill-buckets {text!r}: lengths must "
                              f"be strictly ascending, got {b} after {a}")
     return buckets
+
+
+#: ``--admission`` spec keys → (AdmissionController kwarg, converter,
+#: minimum).  Kept here so a typo'd knob dies at the CLI boundary with
+#: the full key list, not as a TypeError from the controller mid-serve.
+_ADMISSION_KEYS = {
+    "depth": ("max_queue_depth", int, 1),
+    "itl-p99-ms": ("itl_p99_ms", float, 1e-9),
+    "shed-priority": ("shed_priority", int, 1),
+    "patience": ("patience", int, 1),
+    "cool": ("cool", int, 1),
+}
+
+
+def parse_admission_arg(text: str | None,
+                        flag: str = "--admission") -> dict | None:
+    """``--admission`` string → :class:`..serve.admission.
+    AdmissionController` kwargs, validated at parse time (mirrors
+    :func:`parse_mesh_arg`).  Example:
+    ``"depth=16,itl-p99-ms=200,shed-priority=2"``."""
+    if not text:
+        return None
+    out: dict = {}
+    for part in text.split(","):
+        key, _, val = part.strip().partition("=")
+        if key not in _ADMISSION_KEYS:
+            raise SystemExit(
+                f"{flag}: unknown key {key!r} in entry {part!r}; known "
+                f"keys: {', '.join(sorted(_ADMISSION_KEYS))}")
+        name, conv, lo = _ADMISSION_KEYS[key]
+        if name in out:
+            raise SystemExit(f"{flag}: key {key!r} given twice")
+        try:
+            v = conv(val)
+        except ValueError:
+            raise SystemExit(f"{flag}: {key}={val!r} is not a valid "
+                             f"{conv.__name__}") from None
+        if v < lo:
+            raise SystemExit(f"{flag}: {key}={val!r} must be >= {lo}")
+        out[name] = v
+    return out
 
 
 def parse_mesh_arg(text: str | None,
@@ -724,9 +810,29 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
     if args.spec_k < 1:
         raise SystemExit(f"--spec-k {args.spec_k}: must be >= 1")
     for flag, v in (("--slo-ttft-ms", args.slo_ttft_ms),
-                    ("--slo-e2e-ms", args.slo_e2e_ms)):
+                    ("--slo-e2e-ms", args.slo_e2e_ms),
+                    ("--serve-deadline-ms", args.serve_deadline_ms)):
         if v is not None and v <= 0:
             raise SystemExit(f"{flag} {v}: must be positive milliseconds")
+    if args.serve_retries < 0:
+        raise SystemExit(f"--serve-retries {args.serve_retries}: must be "
+                         ">= 0 (0 = error a request on its first engine "
+                         "fault)")
+    if args.canary_slots < 0:
+        raise SystemExit(f"--canary-slots {args.canary_slots}: must be "
+                         ">= 0 (0 swaps verified weights without a "
+                         "canary)")
+    # the cap only binds when a reload watch will actually canary: the
+    # default canary_slots must not invalidate small --max-slots runs
+    if args.reload_watch and args.canary_slots >= args.max_slots:
+        raise SystemExit(f"--canary-slots {args.canary_slots}: must be "
+                         f"< --max-slots {args.max_slots} (at least one "
+                         "slot must keep serving the stable weights)")
+    for flag, v in (("--reload-watch", args.reload_watch),
+                    ("--admission", args.admission)):
+        if v and not args.serve:
+            raise SystemExit(f"{flag} requires --serve (it extends the "
+                             "post-train serving demo)")
     return Config(
         num_layers=args.nlayers,
         size=args.size,
@@ -774,6 +880,11 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         spec_k=args.spec_k,
         slo_ttft_ms=args.slo_ttft_ms,
         slo_e2e_ms=args.slo_e2e_ms,
+        serve_deadline_ms=args.serve_deadline_ms,
+        serve_retries=args.serve_retries,
+        reload_watch=args.reload_watch,
+        canary_slots=args.canary_slots,
+        admission=parse_admission_arg(args.admission),
         pos_embedding=args.pos_embedding,
         num_kv_heads=args.num_kv_heads,
         label_smoothing=args.label_smoothing,

@@ -143,6 +143,42 @@ class MonitorUnhealthy(RuntimeError):
     loop can react to BOTH instead of training blind."""
 
 
+class FlakyIOPolicy:
+    """Consecutive-I/O-error tolerance, shared by every flaky-IO watcher
+    (the heartbeat monitor here, the checkpoint-watch path in
+    ``serve/reload``).
+
+    A transient ``OSError`` says nothing about the thing being watched —
+    tolerate up to ``tolerance`` CONSECUTIVE failures, then declare the
+    WATCHER unhealthy (:class:`MonitorUnhealthy`) instead of silently
+    retrying forever or dying quietly.  One policy object per watcher;
+    one set of semantics for all of them."""
+
+    def __init__(self, tolerance: int = 3, what: str = "scan"):
+        if tolerance < 1:
+            raise ValueError(f"tolerance must be >= 1, got {tolerance}")
+        self.tolerance = int(tolerance)
+        self.what = what
+        self.consecutive = 0
+
+    def note_success(self) -> None:
+        self.consecutive = 0
+
+    def note_error(self, exc: BaseException) -> MonitorUnhealthy | None:
+        """Record one failure; returns the :class:`MonitorUnhealthy` to
+        latch once the tolerance is exhausted (None while tolerating)."""
+        self.consecutive += 1
+        if self.consecutive >= self.tolerance:
+            return MonitorUnhealthy(
+                f"{self.what} failed {self.consecutive} consecutive "
+                f"times ({type(exc).__name__}: {exc}); monitoring "
+                "stopped")
+        return None
+
+    def reset(self) -> None:
+        self.consecutive = 0
+
+
 class FailureMonitor:
     """Background watcher raising :class:`WorkerFailure` via a callback (or
     recording it for polling) when any peer goes stale.
@@ -165,7 +201,8 @@ class FailureMonitor:
         self.io_error_tolerance = io_error_tolerance
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self._io_errors = 0
+        self._io = FlakyIOPolicy(io_error_tolerance,
+                                 what="heartbeat scan")
         self.failure: Exception | None = None
 
     def check(self) -> None:
@@ -192,19 +229,16 @@ class FailureMonitor:
         while not self._stop.wait(self.poll_interval):
             try:
                 self.check()
-                self._io_errors = 0
+                self._io.note_success()
             except WorkerFailure as e:  # record; training thread polls
                 self.failure = e
                 return
             except OSError as e:
                 # shared-FS hiccup: the scan failed, which says nothing
                 # about the PEERS — retry, but never silently forever
-                self._io_errors += 1
-                if self._io_errors >= self.io_error_tolerance:
-                    self.failure = MonitorUnhealthy(
-                        f"heartbeat scan failed {self._io_errors} "
-                        f"consecutive times ({type(e).__name__}: {e}); "
-                        "monitoring stopped")
+                unhealthy = self._io.note_error(e)
+                if unhealthy is not None:
+                    self.failure = unhealthy
                     return
 
     def start(self) -> "FailureMonitor":
@@ -225,7 +259,7 @@ class FailureMonitor:
         subsequent one.  Restarts the background thread only if it had
         been started (and died) before."""
         self.failure = None
-        self._io_errors = 0
+        self._io.reset()
         if self._thread is not None and not self._thread.is_alive() \
                 and not self._stop.is_set():
             self.start()

@@ -533,6 +533,38 @@ def _gpt_generate(config: Config, state, logger, dataset) -> None:
         logger.info(f"generate prompt={row_p} continuation={row_o}")
 
 
+def _serve_supervision_kw(config: Config) -> dict | None:
+    """Supervisor kwargs when any serve-resilience knob is on the CLI
+    (``--serve-deadline-ms`` / ``--reload-watch`` / ``--admission``);
+    ``None`` means run the engine bare, exactly as before the
+    supervisor existed.  ``--serve-retries`` and ``--canary-slots``
+    only shape behaviour once one of the trigger knobs is set."""
+    if (config.serve_deadline_ms is None and not config.reload_watch
+            and config.admission is None):
+        return None
+    return dict(deadline_ms=config.serve_deadline_ms,
+                retries=config.serve_retries,
+                reload_watch=config.reload_watch,
+                canary_slots=config.canary_slots,
+                admission=config.admission)
+
+
+def _log_supervision(logger, sv: dict) -> None:
+    """One log line for the supervisor-level outcome (the engine-level
+    tokens/sec line still follows from ``stats["engine"]``)."""
+    line = (f"serve(supervised): restarts={sv['restarts']}, lost="
+            f"{sv['requests_lost']}, deadline_misses="
+            f"{sv['deadline_misses']}, ticks={sv['ticks']}")
+    r = sv.get("reload")
+    if r:
+        line += (f", reload swaps={r['swaps']} rollbacks={r['rollbacks']}"
+                 f" rejected={r['rejected']}")
+    a = sv.get("admission")
+    if a:
+        line += f", admission level={a['level']} shed={a['shed_total']}"
+    logger.info(line)
+
+
 def _gpt_serve(config: Config, state, logger, dataset) -> None:
     """``--serve``: push a seeded mixed-length request trace (prompts
     drawn over the dataset's vocabulary) through the continuous-batching
@@ -543,7 +575,8 @@ def _gpt_serve(config: Config, state, logger, dataset) -> None:
     instead (block KV + prefix reuse + chunked prefill, ``--draft N``
     speculation) and the log line adds hit rate / acceptance / SLOs."""
     from distributed_deep_learning_tpu.serve.bench import (make_trace,
-                                                           run_engine)
+                                                           run_engine,
+                                                           run_supervised)
 
     params = getattr(state, "params", None)
     if isinstance(params, dict) and "params" in params:
@@ -566,9 +599,21 @@ def _gpt_serve(config: Config, state, logger, dataset) -> None:
     trace = make_trace(max(2 * config.max_slots, 8),
                        vocab_size=_vocab(dataset), seed=config.seed,
                        prompt_lens=(2, p_hi), new_tokens=(1, new_hi))
-    out = run_engine(model, params, trace, max_slots=config.max_slots,
-                     prefill_buckets=config.prefill_buckets)
-    s = out["stats"]
+    sup_kw = _serve_supervision_kw(config)
+    if sup_kw is None:
+        out = run_engine(model, params, trace,
+                         max_slots=config.max_slots,
+                         prefill_buckets=config.prefill_buckets)
+        s = out["stats"]
+    else:
+        out = run_supervised(model, params, trace,
+                             max_slots=config.max_slots,
+                             prefill_buckets=config.prefill_buckets,
+                             **sup_kw)
+        _log_supervision(logger, out["stats"])
+        s = out["stats"]["engine"]
+        if s is None:
+            return
     logger.info(
         f"serve: {s['requests']} requests, {s['generated_tokens']} tokens "
         f"at {s['tokens_per_sec']:.1f} tok/s, occupancy "
@@ -584,7 +629,8 @@ def _gpt_serve_paged(config: Config, model, params, logger, dataset,
 
     from distributed_deep_learning_tpu.serve.bench import (make_trace,
                                                            paged_max_len,
-                                                           run_paged)
+                                                           run_paged,
+                                                           run_supervised)
 
     draft = config.draft or None
     if draft is not None and not 1 <= draft < model.num_layers:
@@ -608,11 +654,21 @@ def _gpt_serve_paged(config: Config, model, params, logger, dataset,
         trace = [dataclasses.replace(r, slo_ttft_ms=config.slo_ttft_ms,
                                      slo_e2e_ms=config.slo_e2e_ms)
                  for r in trace]
-    out = run_paged(model, params, trace, max_slots=config.max_slots,
-                    max_len=cap, kv_block_size=block,
-                    prefill_chunk=min(config.prefill_chunk, cap),
-                    draft_layers=draft, spec_k=config.spec_k)
-    s = out["stats"]
+    engine_kw = dict(max_slots=config.max_slots, max_len=cap,
+                     kv_block_size=block,
+                     prefill_chunk=min(config.prefill_chunk, cap),
+                     draft_layers=draft, spec_k=config.spec_k)
+    sup_kw = _serve_supervision_kw(config)
+    if sup_kw is None:
+        out = run_paged(model, params, trace, **engine_kw)
+        s = out["stats"]
+    else:
+        out = run_supervised(model, params, trace, paged=True,
+                             **engine_kw, **sup_kw)
+        _log_supervision(logger, out["stats"])
+        s = out["stats"]["engine"]
+        if s is None:
+            return
     pg, sp, slo = s["paged"], s["spec"], s["slo"]
     line = (f"serve(paged): {s['requests']} requests, "
             f"{s['generated_tokens']} tokens at "

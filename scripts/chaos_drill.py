@@ -1,7 +1,7 @@
 """Chaos drill: rehearse the detect→contain→recover chain, print one JSON
 line.
 
-Two scenarios, selected with ``--scenario``:
+Three scenarios, selected with ``--scenario``:
 
 * ``resilience`` (default) runs
   :func:`distributed_deep_learning_tpu.utils.chaos.run_resilience_drill`
@@ -16,14 +16,24 @@ Two scenarios, selected with ``--scenario``:
   via ``tune/``, reshard-restore the epoch checkpoint onto the new mesh
   and continue, gating on allclose params/optimizer state and an
   epoch-2 loss matching the uninterrupted topology's.
+* ``serve`` runs
+  :func:`distributed_deep_learning_tpu.utils.chaos.run_serve_resilience_drill`
+  — engine crash / NaN logits / corrupted KV block / stalled tick
+  injected mid-decode under the engine supervisor (every request
+  completes bit-identically, zero lost), slow-tick SLO load under
+  admission control, and the hot weight-swap gauntlet (canary promote,
+  canary rollback with replay, bit-flipped publication rejected by the
+  integrity manifest) — all on ONE engine whose ``decode_compiles``
+  stays 1 throughout.
 
-Both are CPU-runnable (the chains are host+XLA logic, not
+All are CPU-runnable (the chains are host+XLA logic, not
 accelerator-specific); ``bench.py`` embeds the same records as its
-``resilience`` and ``reshard`` sections.
+``resilience``, ``reshard`` and ``serve_resilience`` sections.
 
 Usage::
 
-    python scripts/chaos_drill.py [--seed N] [--scenario resilience|shrink]
+    python scripts/chaos_drill.py [--seed N]
+        [--scenario resilience|shrink|serve]
 """
 
 import argparse
@@ -39,10 +49,12 @@ def main() -> int:
     p.add_argument("--seed", type=int, default=0,
                    help="chaos plan seed (same seed = same faults, "
                         "bit-identical poison masks / kill sets)")
-    p.add_argument("--scenario", choices=("resilience", "shrink"),
+    p.add_argument("--scenario", choices=("resilience", "shrink", "serve"),
                    default="resilience",
                    help="resilience: sentinel/corruption/restart chain; "
-                        "shrink: kill workers, re-plan, reshard, continue")
+                        "shrink: kill workers, re-plan, reshard, continue; "
+                        "serve: engine supervisor replay + hot weight "
+                        "swap + SLO admission under injected serve faults")
     args = p.parse_args()
 
     if args.scenario == "shrink":
@@ -50,6 +62,14 @@ def main() -> int:
             run_shrink_drill
 
         record = run_shrink_drill(seed=args.seed)
+        print(json.dumps(record))
+        return 0 if record["drill_passed"] else 1
+
+    if args.scenario == "serve":
+        from distributed_deep_learning_tpu.utils.chaos import \
+            run_serve_resilience_drill
+
+        record = run_serve_resilience_drill(seed=args.seed)
         print(json.dumps(record))
         return 0 if record["drill_passed"] else 1
 

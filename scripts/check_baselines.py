@@ -18,12 +18,17 @@ Checks:
   allowlist hides future drift);
 * every band is well-formed (known mode, positive value);
 * every band matches at least one baseline key (orphaned bands mean the
-  metric was renamed or its section lost its ``_vs_baseline`` call).
+  metric was renamed or its section lost its ``_vs_baseline`` call);
+* every baseline value is a FINITE number (a NaN/inf or stringly value
+  makes every future ratio vacuously pass) — non-scalar records are
+  allowed only for allowlisted history keys (``tpu:flash_best_blocks``
+  is a block-shape list, not a metric).
 """
 
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 
@@ -46,6 +51,18 @@ def check(baselines: dict, bands: dict,
           allow_unbanded: frozenset = UNBANDED_ALLOWLIST) -> list[str]:
     """All drift findings, empty when consistent (unit-testable core)."""
     problems: list[str] = []
+    for key in sorted(baselines):
+        value = baselines[key]
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            if not math.isfinite(value):
+                problems.append(
+                    f"baseline key {key!r} has non-finite value {value!r} "
+                    "(every future ratio against it is vacuous)")
+        elif key not in allow_unbanded:
+            problems.append(
+                f"baseline key {key!r} has non-numeric value {value!r} "
+                "(only allowlisted history keys may carry non-scalar "
+                "records)")
     for key in sorted(baselines):
         suffix = key.split(":", 1)[-1]
         if suffix in bands:
