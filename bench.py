@@ -445,6 +445,47 @@ def _serving() -> dict | None:
     return out
 
 
+def _serving_quant() -> dict | None:
+    """Quantized serving hot path A/B (ISSUE 14): the same trace through
+    the paged engine at full precision and with int8 block pools + int8
+    per-channel weights (serve/quant.py).  CPU-measurable: the shrink is
+    exact allocated bytes (the ``kv_cache_bytes`` gauge on the REAL
+    pools, scales included), the drift is the calibrated per-token
+    greedy logprob bound, and throughput exercises the same
+    quantize/dequant hot loop XLA compiles on TPU.  The
+    block-table-aware flash-decode kernel itself
+    (ops/paged_decode_pallas.py) harvests on TPU via
+    ``scripts/tpu_validation.py``'s ``serving_quant`` section; CPU runs
+    its interpret-mode parity in tests."""
+    from distributed_deep_learning_tpu.serve.bench import (
+        quantized_serving_bench)
+
+    q_req = int(os.environ.get("BENCH_SERVE_QUANT_REQUESTS", 10))
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", 8))
+    rec = quantized_serving_bench(load_kw=dict(n_requests=q_req),
+                                  max_slots=slots)
+    return {
+        "metric": "quantized serving A/B (int8 KV pools + int8 weights)",
+        "kv_dtype": rec["kv_dtype"],
+        "weight_dtype": rec["weight_dtype"],
+        "tokens_per_sec": rec["quantized"]["tokens_per_sec"],
+        "baseline_tokens_per_sec": rec["baseline"]["tokens_per_sec"],
+        "kv_shrink_x": rec["kv_shrink_x"],
+        "kv_bytes_per_slot": rec["quantized"]["kv_bytes_per_slot"],
+        "baseline_kv_bytes_per_slot": rec["baseline"]["kv_bytes_per_slot"],
+        "max_context_at_budget": rec["quantized"]["max_context_at_budget"],
+        "baseline_max_context_at_budget":
+            rec["baseline"]["max_context_at_budget"],
+        "token_agreement": rec["token_agreement"],
+        "logprob_drift": rec["logprob_drift"],
+        "declared_drift_bound": rec["declared_drift_bound"],
+        "decode_compiles": rec["quantized"]["decode_compiles"],
+        "weight_bytes": rec["quantized"]["weight_bytes"],
+        "requests": q_req,
+        "max_slots": slots,
+    }
+
+
 def _resilience() -> dict | None:
     """Self-healing drill (ISSUE 3): detection latency of the anomaly
     sentinel, checkpoint-corruption fallback, and elastic recovery wall
@@ -794,6 +835,14 @@ REGRESSION_BANDS: dict[str, tuple[str, float]] = {
     "serving_prefix_hit_rate_v1": ("higher", 0.10),
     "serving_slo_attainment_v1": ("higher", 0.25),
     "serving_spec_acceptance_v1": ("higher", 0.25),
+    # quantized serving (ISSUE 14): the shrink is exact allocated bytes
+    # at fixed geometry (deterministic — tight band); throughput rides
+    # the usual CI wall-clock band; the drift ceiling is absolute — the
+    # declared int8 bound (~0.02 on the calibrated probe) plus headroom,
+    # because a ratio against a near-zero drift would be meaningless
+    "serving_quant_kv_shrink_v1": ("higher", 0.05),
+    "serving_quant_tokens_per_sec_v1": ("higher", 0.30),
+    "serving_quant_logprob_drift_v1": ("lower_abs", 0.05),
     "autotune_mlp_steps_per_sec_v1": ("higher", 0.30),
     "reshard_chunked_gb_per_sec_v1": ("higher", 0.35),
     "comm_int8_bytes_reduction_v1": ("higher", 0.05),
@@ -1109,6 +1158,32 @@ def main() -> int:
             print(f"bench: serving section failed "
                   f"({type(exc).__name__}: {exc})", file=sys.stderr)
 
+    # --- serving quantization: int8 KV + int8 weights A/B ------------------
+    serving_quant = None
+    t_squant = 120 if on_tpu else 60
+    if os.environ.get("BENCH_SERVE_QUANT", "1") != "0" and \
+            _time_left() < t_squant:
+        print(f"bench: shedding serving-quant section ({_time_left():.0f}s "
+              "left)", file=sys.stderr)
+    elif os.environ.get("BENCH_SERVE_QUANT", "1") != "0":
+        try:
+            with _section_timer("serving_quant"):
+                serving_quant = _serving_quant()
+            for bkey, val in (
+                    ("serving_quant_kv_shrink_v1",
+                     serving_quant.get("kv_shrink_x")),
+                    ("serving_quant_tokens_per_sec_v1",
+                     serving_quant.get("tokens_per_sec")),
+                    ("serving_quant_logprob_drift_v1",
+                     serving_quant.get("logprob_drift"))):
+                if val is not None:
+                    serving_quant[bkey.replace("_v1", "_vs_baseline")] = \
+                        round(_vs_baseline(baselines, f"{platform}:{bkey}",
+                                           float(val), base_path), 4)
+        except Exception as exc:
+            print(f"bench: serving-quant section failed "
+                  f"({type(exc).__name__}: {exc})", file=sys.stderr)
+
     # --- resilience: the self-healing chain under injected faults ----------
     resilience = None
     t_res = 90 if on_tpu else 60
@@ -1292,6 +1367,7 @@ def main() -> int:
         "lm": lm,
         "input_pipeline": input_pipe,
         "serving": serving,
+        "serving_quant": serving_quant,
         "resilience": resilience,
         "serve_resilience": serve_resilience,
         "autotune": autotune,

@@ -162,6 +162,8 @@ def serving_bench(*, seed: int = 0, n_requests: int = 32,
                   max_slots: int = 8,
                   prefill_buckets: Optional[Sequence[int]] = None,
                   stagger: int = 0, skip_naive: bool = False,
+                  kv_dtype: Optional[str] = None,
+                  weight_dtype: Optional[str] = None,
                   telemetry=None) -> dict:
     """The full A/B at one configuration; returns the ``serving``
     record ``bench.py`` embeds and ``scripts/serve_bench.py`` prints."""
@@ -175,11 +177,14 @@ def serving_bench(*, seed: int = 0, n_requests: int = 32,
                        stagger=stagger)
 
     eng = run_engine(model, params, trace, telemetry=telemetry,
-                     max_slots=max_slots, prefill_buckets=prefill_buckets)
+                     max_slots=max_slots, prefill_buckets=prefill_buckets,
+                     kv_dtype=kv_dtype, weight_dtype=weight_dtype)
     es = eng["stats"]
     record = {
         "metric": "serving throughput tokens/sec (mixed-length trace)",
         "model": {**DEFAULT_MODEL, **(model_kw or {})},
+        "kv_dtype": kv_dtype,
+        "weight_dtype": weight_dtype,
         "requests": n_requests,
         "prompt_lens": list(prompt_lens),
         "new_tokens": list(new_tokens),
@@ -290,6 +295,8 @@ def paged_serving_bench(*, seed: int = 0,
                         draft_layers: Optional[int] = None,
                         spec_k: int = 4,
                         compare_engine: bool = True,
+                        kv_dtype: Optional[str] = None,
+                        weight_dtype: Optional[str] = None,
                         telemetry=None) -> dict:
     """The paged-generation bench: one trace-driven load (``DEFAULT_LOAD``
     overridden by ``load_kw``) through :class:`PagedEngine`, optionally
@@ -318,7 +325,8 @@ def paged_serving_bench(*, seed: int = 0,
                     max_slots=max_slots, max_len=cap,
                     kv_block_size=kv_block_size,
                     prefill_chunk=min(prefill_chunk, cap),
-                    draft_layers=draft_layers, spec_k=spec_k)
+                    draft_layers=draft_layers, spec_k=spec_k,
+                    kv_dtype=kv_dtype, weight_dtype=weight_dtype)
     ps = res["stats"]
     record = {
         "metric": "paged serving under trace-driven SLO load",
@@ -327,6 +335,8 @@ def paged_serving_bench(*, seed: int = 0,
         "max_slots": max_slots,
         "kv_block_size": kv_block_size,
         "prefill_chunk": prefill_chunk,
+        "kv_dtype": kv_dtype,
+        "weight_dtype": weight_dtype,
         "errors": len(res["errors"]),
         "paged_engine": {
             "tokens_per_sec": round(ps["tokens_per_sec"], 2),
@@ -373,3 +383,121 @@ def paged_serving_bench(*, seed: int = 0,
             record["speedup_vs_v1"] = round(
                 ps["tokens_per_sec"] / vs["tokens_per_sec"], 3)
     return record
+
+
+def _token_agreement(a: dict, b: dict) -> float:
+    """Fraction of greedy tokens identical between two result maps
+    (uid -> token array) over their shared uids."""
+    total = same = 0
+    for uid, toks in a.items():
+        if uid not in b:
+            continue
+        other = np.asarray(b[uid])
+        toks = np.asarray(toks)
+        n = min(len(toks), len(other))
+        total += n
+        same += int(np.sum(toks[:n] == other[:n]))
+    return same / total if total else 1.0
+
+
+def quantized_serving_bench(*, seed: int = 0,
+                            load_kw: Optional[dict] = None,
+                            model_kw: Optional[dict] = None,
+                            max_slots: int = 8,
+                            kv_block_size: int = 16,
+                            prefill_chunk: int = 32,
+                            kv_dtype: str = "int8",
+                            weight_dtype: str = "int8",
+                            telemetry=None) -> dict:
+    """The quantized-serving A/B: the SAME trace through the paged
+    engine at full precision and again with ``kv_dtype`` block pools +
+    ``weight_dtype`` weights.
+
+    The record carries the three numbers the CI baseline tracks:
+
+    * ``kv_shrink_x`` — full-precision / quantized ``kv_cache_bytes``
+      at identical slots x capacity (the gauge measures the REAL
+      resident pools, scales included, so this is the honest at-rest
+      shrink, not the 4x a bare dtype ratio would claim);
+    * ``tokens_per_sec`` of the quantized arm (decode is memory-bound,
+      so the shrink should never cost throughput — the band protects
+      against a quantize/dequant regression in the hot loop);
+    * ``logprob_drift`` — the CALIBRATED per-token greedy logprob
+      drift of the quantized weights (:func:`..serve.quant.
+      calibrate_weight_drift` over a probe batch drawn from the trace),
+      which is also the declared bound the parity tests gate int8 on.
+
+    Plus ``max_context_at_budget``: how many KV positions fit in the
+    full-precision pools' byte footprint under each representation —
+    the "max context before OOM" number, computed from measured bytes
+    per position rather than an OOM hunt (deterministic on CPU, and
+    exactly how the HBM memory model would plan it).
+    """
+    from distributed_deep_learning_tpu.serve import quant
+
+    model, params = build_model(seed, **(model_kw or {}))
+    spec = LoadSpec(**{**DEFAULT_LOAD, **(load_kw or {})})
+    cap = paged_max_len(model.max_len, kv_block_size, False, 0)
+    trace = make_load(spec, vocab_size=model.vocab_size, seed=seed)
+    engine_kw = dict(max_slots=max_slots, max_len=cap,
+                     kv_block_size=kv_block_size,
+                     prefill_chunk=min(prefill_chunk, cap))
+
+    base = run_paged(model, params, trace, **engine_kw)
+    bs_ = base["stats"]
+    q = run_paged(model, params, trace, telemetry=telemetry,
+                  kv_dtype=kv_dtype, weight_dtype=weight_dtype,
+                  **engine_kw)
+    qs = q["stats"]
+
+    # measured bytes per KV position (pool bytes / pool capacity) under
+    # each representation -> max context inside the BASELINE's budget
+    positions = bs_["paged"]["blocks_total"] * kv_block_size
+    budget = bs_["kv_cache_bytes"]
+    base_ctx = int(budget // (bs_["kv_cache_bytes"] / positions))
+    quant_ctx = int(budget // (qs["kv_cache_bytes"] / positions))
+
+    # the declared int8 weight-drift bound, measured on a probe batch of
+    # real trace prompts (greedy trajectory logprobs, full forward)
+    probe = np.concatenate([np.asarray(r.prompt) for r in trace[:4]])[:64]
+    drift = quant.calibrate_weight_drift(
+        model, params, quant.quantize_weights(params, weight_dtype),
+        probe) if weight_dtype else {
+            "measured_max_drift": 0.0, "declared_bound": 0.0,
+            "probe_argmax_agreement": 1.0, "probe_tokens": 0}
+
+    return {
+        "metric": "quantized serving hot path A/B (paged engine)",
+        "model": {**DEFAULT_MODEL, **(model_kw or {})},
+        "load": {**DEFAULT_LOAD, **(load_kw or {})},
+        "max_slots": max_slots,
+        "kv_block_size": kv_block_size,
+        "kv_dtype": kv_dtype,
+        "weight_dtype": weight_dtype,
+        "errors": len(base["errors"]) + len(q["errors"]),
+        "baseline": {
+            "tokens_per_sec": round(bs_["tokens_per_sec"], 2),
+            "kv_cache_bytes": bs_["kv_cache_bytes"],
+            "kv_bytes_per_slot": bs_["kv_cache_bytes"] // max_slots,
+            "max_context_at_budget": base_ctx,
+            "decode_compiles": bs_["decode_compiles"],
+        },
+        "quantized": {
+            "tokens_per_sec": round(qs["tokens_per_sec"], 2),
+            "kv_cache_bytes": qs["kv_cache_bytes"],
+            "kv_bytes_per_slot": qs["kv_cache_bytes"] // max_slots,
+            "max_context_at_budget": quant_ctx,
+            "decode_compiles": qs["decode_compiles"],
+            "chunk_compiles": qs["chunk_compiles"],
+            "weight_bytes": quant.weight_bytes(
+                quant.quantize_weights(params, weight_dtype))
+            if weight_dtype else quant.weight_bytes(params),
+        },
+        "kv_shrink_x": round(
+            bs_["kv_cache_bytes"] / qs["kv_cache_bytes"], 3),
+        "token_agreement": round(
+            _token_agreement(base["results"], q["results"]), 4),
+        "logprob_drift": round(drift["measured_max_drift"], 5),
+        "declared_drift_bound": round(drift["declared_bound"], 5),
+        "probe_argmax_agreement": drift["probe_argmax_agreement"],
+    }

@@ -36,6 +36,11 @@ from distributed_deep_learning_tpu.models.transformer import init_cache
 #: vectors in the slot table.
 COUNTER_LEAVES = ("cache_index", "pos_index")
 
+#: cache-collection leaf names that hold actual key/value tensors — the
+#: leaves the serving quantization path (:mod:`.quant`) stores in reduced
+#: precision.  ``cached_valid`` (bool) and the counters stay exact.
+KV_LEAVES = ("cached_key", "cached_value")
+
 
 def _leaf_name(path) -> str:
     last = path[-1]
@@ -85,14 +90,36 @@ def unlift(cache):
     return jax.tree.map(lambda x: x[0] if jnp.ndim(x) else x, cache)
 
 
-def write_slot(slots, cache, slot):
+def write_slot(slots, cache, slot, quantizer=None):
     """Write a model-layout (``B=1``) ``cache`` into row ``slot`` of the
     table.  ``slot`` may be traced (an int32 scalar), so one compiled
-    prefill program serves every slot."""
+    prefill program serves every slot.
+
+    Precision contract: a floating-point update may land in a LOWER
+    floating precision slab (bf16 — the cast IS the quantization), but
+    writing it into an INTEGER slab through a bare ``astype`` would
+    silently round-and-wrap with no scale.  Integer slabs therefore
+    require ``quantizer`` (a leaf map producing the slab's exact dtype,
+    normally built on :mod:`.quant`'s scale-aware path); without one the
+    write raises instead of corrupting the cache.
+    """
     def wr(slab, upd):
         if slab.ndim == 1:                      # counter vector <- scalar
             upd = jnp.reshape(upd, (1,)).astype(slab.dtype)
             return jax.lax.dynamic_update_slice(slab, upd, (slot,))
+        if jnp.issubdtype(slab.dtype, jnp.integer) and \
+                jnp.issubdtype(upd.dtype, jnp.floating):
+            if quantizer is None:
+                raise TypeError(
+                    f"write_slot: float {upd.dtype} update into an "
+                    f"integer {slab.dtype} slab — a bare astype would "
+                    "truncate without a scale; pass quantizer= (the "
+                    "scale-aware serve.quant path)")
+            upd = quantizer(upd)
+            if upd.dtype != slab.dtype:
+                raise TypeError(
+                    f"write_slot: quantizer produced {upd.dtype}, "
+                    f"slab holds {slab.dtype}")
         starts = (slot,) + (0,) * (slab.ndim - 1)
         return jax.lax.dynamic_update_slice(slab, upd.astype(slab.dtype),
                                             starts)

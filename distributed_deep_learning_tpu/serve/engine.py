@@ -44,6 +44,7 @@ from distributed_deep_learning_tpu.obs.metrics import MetricsRegistry
 from distributed_deep_learning_tpu.obs.window import LiveSignals
 from distributed_deep_learning_tpu.serve import cache as slot_cache
 from distributed_deep_learning_tpu.serve import paged
+from distributed_deep_learning_tpu.serve import quant
 from distributed_deep_learning_tpu.serve import spec as spec_mod
 from distributed_deep_learning_tpu.serve.load import slo_report
 from distributed_deep_learning_tpu.serve.prefill import (chunk_tokens,
@@ -192,8 +193,24 @@ class ServeEngine:
                  prefill_buckets: Optional[Sequence[int]] = None,
                  eos_id: Optional[int] = None, temperature: float = 0.0,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
-                 rng=None, donate: Optional[bool] = None):
+                 rng=None, donate: Optional[bool] = None,
+                 kv_dtype: Optional[str] = None,
+                 weight_dtype: Optional[str] = None):
         validate_sampling(top_k, top_p)
+        quant.check_dtype("kv_dtype", kv_dtype)
+        quant.check_dtype("weight_dtype", weight_dtype)
+        if kv_dtype == "int8":
+            raise ValueError(
+                "kv_dtype='int8' requires the paged engine (PagedEngine /"
+                " --paged): int8 KV stores per-position scales alongside "
+                "the block pools; the v1 slot table supports bf16 only")
+        self.kv_dtype, self.weight_dtype = kv_dtype, weight_dtype
+        # the model's working precision, captured BEFORE the params go
+        # to their at-rest form: every compiled impl dequantizes back to
+        # this dtype at its top (XLA fuses the upcast into the matmuls)
+        self.compute_dtype = jax.tree.leaves(params)[0].dtype
+        if weight_dtype is not None:
+            params = quant.quantize_weights(params, weight_dtype)
         self.model, self.params = model, params
         self.lm = make_decode_model(model)
         self.max_slots = int(max_slots)
@@ -223,8 +240,7 @@ class ServeEngine:
         if donate is None:
             donate = jax.default_backend() != "cpu"
         dk = {"donate_argnums": (1,)} if donate else {}
-        self.slots = slot_cache.allocate_slots(self.lm, self.max_slots,
-                                               self.max_len)
+        self.slots = self._alloc_slots()
         # exact KV footprint by construction: the allocated cache pytree's
         # own shapes (what the analytic layers x 2 x slots x len x kv-heads
         # x head-dim computation must reproduce bit-exactly)
@@ -233,6 +249,34 @@ class ServeEngine:
         self._decode = CountingJit(self._decode_impl, **dk)
         self.restarts = 0
         self.weight_swaps = 0
+
+    # --- quantization shims (identity at full precision) ------------------
+    def _alloc_slots(self):
+        slots = slot_cache.allocate_slots(self.lm, self.max_slots,
+                                          self.max_len)
+        if self.kv_dtype == "bf16":
+            slots = quant.cast_kv(slots, jnp.bfloat16)
+        return slots
+
+    def _wp(self, params):
+        """At-rest params -> compute-dtype view (inside the jitted impl,
+        so the upcast fuses into the consuming matmuls)."""
+        if self.weight_dtype is None:
+            return params
+        return quant.dequantize_weights(params, self.compute_dtype)
+
+    def _kv_in(self, cache):
+        """Stored cache -> the model's working precision (the model's
+        ``dynamic_update_slice`` writes are dtype-strict)."""
+        if self.kv_dtype is None:
+            return cache
+        return quant.cast_kv(cache, self.compute_dtype)
+
+    def _kv_out(self, cache):
+        """Freshly-computed cache -> the slab's at-rest precision."""
+        if self.kv_dtype is None:
+            return cache
+        return quant.cast_kv(cache, jnp.bfloat16)
 
     # --- the two compiled programs ---------------------------------------
     def _sample(self, params, hidden_last, key):
@@ -251,10 +295,11 @@ class ServeEngine:
 
     def _prefill_impl(self, params, slots, tokens, slot, true_len, key):
         """(Pb,)-padded prompt -> slot ``slot`` filled, first token out."""
-        fresh = slot_cache.fresh_slot(slots)
+        params = self._wp(params)
+        fresh = self._kv_in(slot_cache.fresh_slot(slots))
         hidden, new = cached_apply(self.lm, params, fresh, tokens[None])
         new = slot_cache.fix_counters(new, true_len)
-        slots = slot_cache.write_slot(slots, new, slot)
+        slots = slot_cache.write_slot(slots, self._kv_out(new), slot)
         # sample from the TRUE final position, not the padded tail
         h_last = jax.lax.dynamic_slice_in_dim(hidden[0], true_len - 1, 1)
         tok, lp, ok = self._sample(params, h_last, key)
@@ -263,10 +308,12 @@ class ServeEngine:
     def _decode_impl(self, params, slots, toks, key):
         """One token for every slot: the model's single-sequence cached
         decode vmapped over the slot axis, then one shared sampling."""
+        params = self._wp(params)
+
         def one(per_slot, tok):
-            c = slot_cache.lift(per_slot)
+            c = self._kv_in(slot_cache.lift(per_slot))
             hidden, new = cached_apply(self.lm, params, c, tok[None, None])
-            return slot_cache.unlift(new), hidden[0, 0]
+            return slot_cache.unlift(self._kv_out(new)), hidden[0, 0]
 
         slots, h = jax.vmap(one)(slots, toks)     # h: (max_slots, d)
         toks, lp, ok = self._sample(params, h, key)
@@ -300,14 +347,19 @@ class ServeEngine:
         poisoned KV dies here), SAME compiled programs — the new cache
         pytree has identical shapes, so no program retraces and
         ``decode_compiles`` stays where it was."""
-        self.slots = slot_cache.allocate_slots(self.lm, self.max_slots,
-                                               self.max_len)
+        self.slots = self._alloc_slots()
         self.restarts += 1
 
     def swap_params(self, new_params) -> None:
         """Hot weight swap between ticks: same tree/shapes/dtypes slide
         into the already-compiled programs (params are traced arguments,
-        never baked constants), so no recompile happens."""
+        never baked constants), so no recompile happens.  Incoming
+        weights are published full-precision; a quantized engine takes
+        them to its at-rest form FIRST, so the geometry check compares
+        like with like."""
+        if self.weight_dtype is not None:
+            new_params = quant.quantize_weights(new_params,
+                                                self.weight_dtype)
         _check_swappable(self.params, new_params)
         self.params = new_params
         self.weight_swaps += 1
@@ -551,6 +603,8 @@ class ServeEngine:
                 occupancy_sum / decode_ticks if decode_ticks else 0.0,
             "max_slots": self.max_slots,
             "kv_cache_bytes": self.kv_cache_bytes,
+            "kv_dtype": self.kv_dtype,
+            "weight_dtype": self.weight_dtype,
             "prefill_compiles": self._prefill.traces,
             "decode_compiles": self._decode.traces,
             "restarts": self.restarts,
@@ -604,8 +658,19 @@ class PagedEngine:
                  draft_layers: Optional[int] = None, spec_k: int = 4,
                  eos_id: Optional[int] = None, temperature: float = 0.0,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
-                 rng=None, donate: Optional[bool] = None):
+                 rng=None, donate: Optional[bool] = None,
+                 kv_dtype: Optional[str] = None,
+                 weight_dtype: Optional[str] = None):
         validate_sampling(top_k, top_p)
+        quant.check_dtype("kv_dtype", kv_dtype)
+        quant.check_dtype("weight_dtype", weight_dtype)
+        self.kv_dtype, self.weight_dtype = kv_dtype, weight_dtype
+        # working precision, captured before params go at-rest (the
+        # compiled impls dequantize back to it at their top — see
+        # ServeEngine; same contract here)
+        self.compute_dtype = jax.tree.leaves(params)[0].dtype
+        if weight_dtype is not None:
+            params = quant.quantize_weights(params, weight_dtype)
         self.model, self.params = model, params
         self.lm = make_decode_model(model)
         self.max_slots = int(max_slots)
@@ -663,16 +728,21 @@ class PagedEngine:
         dk = {"donate_argnums": (1,)} if donate else {}
         ck = {"donate_argnums": (0,)} if donate else {}
         self.pools = paged.build_pools(self.lm, num_blocks + 1, bs,
-                                       self.padded_len)
+                                       self.padded_len,
+                                       kv_dtype=self.kv_dtype)
         self._chunk_prog = CountingJit(self._chunk_impl, **dk)
         self._decode = CountingJit(self._decode_impl, **dk)
         self._copy = CountingJit(self._copy_impl, **ck)
         if draft_layers is not None:
             self.draft_lm, self.draft_params = spec_mod.truncated_draft(
                 self.lm, params, draft_layers)
+            # the draft pool INHERITS kv_dtype: speculation gathers and
+            # scatters through the same shims, so a mixed-precision pair
+            # would silently double the draft's footprint
             self.draft_pools = paged.build_pools(self.draft_lm,
                                                  num_blocks + 1, bs,
-                                                 self.padded_len)
+                                                 self.padded_len,
+                                                 kv_dtype=self.kv_dtype)
             self._draft = CountingJit(self._draft_impl, **dk)
             self._verify = CountingJit(self._verify_impl, **dk)
             self._draft_chunk = CountingJit(self._draft_chunk_impl, **dk)
@@ -687,6 +757,31 @@ class PagedEngine:
         self._spec_enabled = draft_layers is not None
         self._base_chunks_per_tick = self.chunks_per_tick
         self._canary: Optional[_CanaryState] = None
+
+    # --- quantization shims (identity at full precision) ------------------
+    def _wp(self, params):
+        """At-rest params -> compute-dtype view inside the jitted impl
+        (the int8 upcast fuses into each consuming matmul; no full-
+        precision weight copy exists between programs)."""
+        if self.weight_dtype is None:
+            return params
+        return quant.dequantize_weights(params, self.compute_dtype)
+
+    def _gather(self, pools, table, pos):
+        """Gather one slot's logical cache and lift it to the model's
+        working precision (int8 pools dequantize ``q * s`` in f32)."""
+        got = paged.gather_slot(pools, table, pos)
+        if self.kv_dtype is None:
+            return got
+        return quant.dequant_cache(got, self.compute_dtype)
+
+    def _qspan(self, span):
+        """Freshly-computed floating KV span -> the pools' at-rest
+        representation (per-position-per-head int8 scales travel with
+        the payload as one :class:`..serve.quant.QuantTensor`)."""
+        if self.kv_dtype is None:
+            return span
+        return quant.quantize_cache_span(span, self.kv_dtype)
 
     # --- compiled programs (each traces exactly once) ---------------------
     def _sample(self, params, hidden_last, key):
@@ -711,10 +806,11 @@ class PagedEngine:
         padding positions routed to trash), and sample at ``logit_idx``
         (meaningful on the final chunk only — the caller ignores it
         otherwise; the extra 1-row head projection is noise)."""
-        cache = paged.gather_slot(pools, table, pos)
+        params = self._wp(params)
+        cache = self._gather(pools, table, pos)
         hidden, new = cached_apply(self.lm, params, cache, tokens[None])
         span = paged.extract_span(new, pos, self.chunk)
-        pools = paged.scatter_span(pools, span, wb, wo)
+        pools = paged.scatter_span(pools, self._qspan(span), wb, wo)
         h_last = jax.lax.dynamic_slice_in_dim(hidden[0], logit_idx, 1)
         tok, lp, ok = self._sample(params, h_last, key)
         return pools, tok[0], lp[0], ok[0]
@@ -723,10 +819,11 @@ class PagedEngine:
                           wb, wo):
         """The draft model's KV for the same chunk — speculation needs
         the draft's cache warm over the whole committed stream."""
-        cache = paged.gather_slot(dpools, table, pos)
+        dparams = self._wp(dparams)
+        cache = self._gather(dpools, table, pos)
         _, new = cached_apply(self.draft_lm, dparams, cache, tokens[None])
         span = paged.extract_span(new, pos, self.chunk)
-        return paged.scatter_span(dpools, span, wb, wo)
+        return paged.scatter_span(dpools, self._qspan(span), wb, wo)
 
     def _decode_impl(self, params, pools, tables, positions, toks,
                      wb, wo, key):
@@ -735,8 +832,10 @@ class PagedEngine:
         (vmapped), scatter each slot's new KV position back, one shared
         sampling.  Free/prefilling slots run on garbage and write to
         trash; their sampled tokens are ignored by the host."""
+        params = self._wp(params)
+
         def one(table, pos, tok):
-            cache = paged.gather_slot(pools, table, pos)
+            cache = self._gather(pools, table, pos)
             hidden, new = cached_apply(self.lm, params, cache,
                                        tok[None, None])
             return hidden[0, 0], paged.extract_span(new, pos, 1)
@@ -744,7 +843,7 @@ class PagedEngine:
         h, spans = jax.vmap(one)(tables, positions, toks)
         kv = jax.tree_util.tree_map_with_path(
             lambda p, x: x if paged.is_counter(p) else x[:, 0], spans)
-        pools = paged.scatter_span(pools, kv, wb, wo)
+        pools = paged.scatter_span(pools, self._qspan(kv), wb, wo)
         toks, lp, ok = self._sample(params, h, key)
         return pools, toks, lp, ok
 
@@ -755,9 +854,10 @@ class PagedEngine:
         extra step exists to WRITE position ``c+k`` (its proposal is
         discarded) so an all-accept round leaves no KV hole."""
         T = self.spec_k + 1
+        dparams = self._wp(dparams)
 
         def one(table, pos, tok):
-            cache = paged.gather_slot(dpools, table, pos)
+            cache = self._gather(dpools, table, pos)
 
             def step(carry, _):
                 c, t = carry
@@ -774,7 +874,7 @@ class PagedEngine:
             return outs, paged.extract_span(cache, pos, T)
 
         outs, spans = jax.vmap(one)(tables, positions, toks)
-        dpools = paged.scatter_span(dpools, spans, wb, wo)
+        dpools = paged.scatter_span(dpools, self._qspan(spans), wb, wo)
         return dpools, outs[:, :self.spec_k]
 
     def _verify_impl(self, params, pools, tables, positions, toks, wb, wo):
@@ -784,14 +884,15 @@ class PagedEngine:
         This is the whole speedup: ``a + 1`` tokens per target forward
         instead of 1."""
         T = self.spec_k + 1
+        params = self._wp(params)
 
         def one(table, pos, tk):
-            cache = paged.gather_slot(pools, table, pos)
+            cache = self._gather(pools, table, pos)
             hidden, new = cached_apply(self.lm, params, cache, tk[None])
             return hidden[0], paged.extract_span(new, pos, T)
 
         h, spans = jax.vmap(one)(tables, positions, toks)
-        pools = paged.scatter_span(pools, spans, wb, wo)
+        pools = paged.scatter_span(pools, self._qspan(spans), wb, wo)
         g, lp, _ = self._sample(params, h.reshape(-1, h.shape[-1]),
                                 jax.random.key(0))
         ok = jnp.isfinite(h).all(axis=(1, 2))
@@ -874,11 +975,12 @@ class PagedEngine:
                                           self.max_slots,
                                           self.blocks_per_slot)
         self.pools = paged.build_pools(self.lm, self.num_blocks + 1,
-                                       self.block_size, self.padded_len)
+                                       self.block_size, self.padded_len,
+                                       kv_dtype=self.kv_dtype)
         if self.draft_layers is not None:
             self.draft_pools = paged.build_pools(
                 self.draft_lm, self.num_blocks + 1, self.block_size,
-                self.padded_len)
+                self.padded_len, kv_dtype=self.kv_dtype)
         self.restarts += 1
 
     def swap_params(self, new_params) -> None:
@@ -887,7 +989,13 @@ class PagedEngine:
         constants) — no recompile.  The prefix index is flushed: its KV
         was computed under the old weights, and matching it under the
         new ones would mix generations.  Draft params re-derive from
-        the new target (they share weights by construction)."""
+        the new target (they share weights by construction).  A
+        quantized engine takes the (full-precision) publish to its
+        at-rest form first, so the geometry check compares like with
+        like."""
+        if self.weight_dtype is not None:
+            new_params = quant.quantize_weights(new_params,
+                                                self.weight_dtype)
         _check_swappable(self.params, new_params)
         self.params = new_params
         if self.draft_layers is not None:
@@ -920,6 +1028,9 @@ class PagedEngine:
             raise RuntimeError(
                 "canary mode requires a non-speculative engine (the "
                 "draft's shared cache cannot serve two weight sets)")
+        if self.weight_dtype is not None:
+            new_params = quant.quantize_weights(new_params,
+                                                self.weight_dtype)
         _check_swappable(self.params, new_params)
         sl = frozenset(int(s) for s in slots)
         if not sl or not all(0 <= s < self.max_slots for s in sl):
@@ -1474,6 +1585,8 @@ class PagedEngine:
                 occupancy_sum / decode_ticks if decode_ticks else 0.0,
             "max_slots": self.max_slots,
             "kv_cache_bytes": self.kv_cache_bytes,
+            "kv_dtype": self.kv_dtype,
+            "weight_dtype": self.weight_dtype,
             "kv_block_size": bs,
             "prefill_chunk": self.chunk,
             "chunk_compiles": self._chunk_prog.traces,

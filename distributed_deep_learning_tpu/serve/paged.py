@@ -49,6 +49,7 @@ import numpy as np
 
 from distributed_deep_learning_tpu.models.transformer import init_cache
 from distributed_deep_learning_tpu.serve.cache import (COUNTER_LEAVES,
+                                                       KV_LEAVES,
                                                        _leaf_name)
 
 #: physical id of the write-discard / read-garbage block (never allocated)
@@ -73,7 +74,7 @@ def chain_hash(prev: bytes, tokens: Sequence[int]) -> bytes:
 
 
 def build_pools(lm, num_blocks: int, block_size: int, padded_len: int,
-                token_dtype=jnp.int32):
+                token_dtype=jnp.int32, kv_dtype: Optional[str] = None):
     """Zeroed block pools shaped from the decode model's own cache.
 
     ``eval_shape`` of a ``(1, padded_len)`` cache init gives the leaf
@@ -81,7 +82,14 @@ def build_pools(lm, num_blocks: int, block_size: int, padded_len: int,
     ``(num_blocks, block_size, ...)`` pools, counter leaves shrink to a
     placeholder (positions are host-owned — the host scheduler must know
     every slot's position anyway, so the device copy would only mirror
-    it; :func:`gather_slot` injects the host value instead)."""
+    it; :func:`gather_slot` injects the host value instead).
+
+    ``kv_dtype`` picks the at-rest precision of the KV payload leaves:
+    ``None`` keeps the model's own dtype, ``"bf16"`` halves it, and
+    ``"int8"`` stores each KV leaf as a :class:`.quant.QuantTensor`
+    (int8 pool + an f32 per-position-per-head scale pool with the same
+    leading dims, so every tree-mapped pool op below indexes both
+    coherently).  Bool validity and counters are exact regardless."""
     if padded_len != (padded_len // block_size) * block_size:
         raise ValueError(f"padded_len {padded_len} must be a multiple of "
                          f"block_size {block_size}")
@@ -90,8 +98,19 @@ def build_pools(lm, num_blocks: int, block_size: int, padded_len: int,
     def alloc(path, leaf):
         if is_counter(path):
             return jnp.zeros((), leaf.dtype)          # unused placeholder
-        return jnp.zeros((num_blocks, block_size) + leaf.shape[2:],
-                         leaf.dtype)
+        shape = (num_blocks, block_size) + leaf.shape[2:]
+        if kv_dtype is not None and _leaf_name(path) in KV_LEAVES \
+                and jnp.issubdtype(leaf.dtype, jnp.floating):
+            if kv_dtype == "bf16":
+                return jnp.zeros(shape, jnp.bfloat16)
+            if kv_dtype == "int8":
+                from distributed_deep_learning_tpu.serve.quant import \
+                    QuantTensor
+                return QuantTensor(
+                    jnp.zeros(shape, jnp.int8),
+                    jnp.zeros(shape[:-1] + (1,), jnp.float32))
+            raise ValueError(f"unknown kv_dtype {kv_dtype!r}")
+        return jnp.zeros(shape, leaf.dtype)
 
     return jax.tree_util.tree_map_with_path(alloc, per_slot)
 
@@ -137,6 +156,13 @@ def scatter_span(pools, kv, blocks, offsets):
     def s(path, pool, upd):
         if is_counter(path):
             return pool
+        if jnp.issubdtype(pool.dtype, jnp.integer) and \
+                jnp.issubdtype(upd.dtype, jnp.floating):
+            raise TypeError(
+                f"scatter_span: float {upd.dtype} span into an integer "
+                f"{pool.dtype} pool — a bare astype would truncate "
+                "without a scale; quantize the span first "
+                "(serve.quant.quantize_cache_span)")
         return pool.at[blocks, offsets].set(upd.astype(pool.dtype))
 
     return jax.tree_util.tree_map_with_path(s, pools, kv)

@@ -22,7 +22,10 @@ from distributed_deep_learning_tpu.utils.config import Config
 #: v2: Plan grew the ``comm``/``comm_overlap`` axes (quantized +
 #: ring-overlapped FSDP collectives) — v1 artifacts predate them and
 #: must re-search, not silently replay without the new knobs
-PLAN_SCHEMA_VERSION = 2
+#: v3: Plan grew the serving-surface axes ``paged``/``kv_dtype``/
+#: ``weight_dtype`` (quantized serving hot path) — v2 artifacts lack
+#: them and must re-search for the same reason
+PLAN_SCHEMA_VERSION = 3
 
 
 class StalePlanError(ValueError):

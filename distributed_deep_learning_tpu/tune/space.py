@@ -46,6 +46,12 @@ class Plan:
     comm_overlap: bool = False
     attention: str = "auto"
     dtype: str = "float32"
+    # serving-surface axes (ISSUE 14): engine generation + quantized
+    # storage dtypes.  "none" = full precision, so the training-only
+    # lattice corner is the all-default Plan and old anchors hold.
+    paged: bool = False
+    kv_dtype: str = "none"
+    weight_dtype: str = "none"
 
     def mesh_dict(self) -> dict[str, int]:
         return dict(self.mesh)
@@ -80,6 +86,11 @@ class Plan:
             "comm_overlap": self.comm_overlap,
             "attention": self.attention,
             "dtype": self.dtype,
+            "paged": self.paged,
+            # Config stores the serving dtypes as Optional[str]
+            "kv_dtype": None if self.kv_dtype == "none" else self.kv_dtype,
+            "weight_dtype": None if self.weight_dtype == "none"
+            else self.weight_dtype,
         }
 
     def to_dict(self) -> dict:
@@ -110,6 +121,12 @@ class Plan:
         if self.attention != "auto":
             bits.append(f"attention={self.attention}")
         bits.append(self.dtype)
+        if self.paged:
+            bits.append("paged")
+        if self.kv_dtype != "none":
+            bits.append(f"kv={self.kv_dtype}")
+        if self.weight_dtype != "none":
+            bits.append(f"w={self.weight_dtype}")
         return " ".join(bits)
 
 
@@ -138,7 +155,10 @@ def plan_from_config(config: Config, n_devices: int) -> Plan:
                 remat_policy=config.remat_policy, zero=config.zero,
                 grad_compress=config.grad_compress, comm=config.comm,
                 comm_overlap=config.comm_overlap,
-                attention=config.attention, dtype=config.dtype)
+                attention=config.attention, dtype=config.dtype,
+                paged=config.paged,
+                kv_dtype=config.kv_dtype or "none",
+                weight_dtype=config.weight_dtype or "none")
 
 
 def _mesh_candidates(n_devices: int) -> list[tuple[tuple[str, int], ...]]:
@@ -175,6 +195,9 @@ def enumerate_plans(n_devices: int, batch_size: int, *,
                                                        "int8"),
                     comm_options: Sequence[str] = ("none", "bf16", "int8"),
                     comm_overlap_options: Sequence[bool] = (False, True),
+                    paged_options: Sequence[bool] = (False,),
+                    kv_dtype_options: Sequence[str] = ("none",),
+                    weight_dtype_options: Sequence[str] = ("none",),
                     ) -> list[Plan]:
     """Enumerate the legal plan lattice, in deterministic order.
 
@@ -190,6 +213,11 @@ def enumerate_plans(n_devices: int, batch_size: int, *,
       over a size-1 axis is a no-op plan already covered by ``none``
     * ``--comm`` (explicit quantized FSDP collectives) needs ``zero=fsdp``
       with no accumulation; ``--comm-overlap`` needs ``--comm``
+    * serving axes (singleton defaults — the training search is
+      unchanged unless a serving sweep opts in): ``kv_dtype="int8"``
+      needs ``paged=True`` (per-position scales live in the block
+      pools; the v1 slot table supports bf16 only), mirroring the
+      ``--kv-dtype int8 requires --paged`` CLI rejection
     """
     plans: list[Plan] = []
     for mesh in _mesh_candidates(n_devices):
@@ -222,10 +250,24 @@ def enumerate_plans(n_devices: int, batch_size: int, *,
                                     continue
                                 for attention in attention_options:
                                     for dtype in dtypes:
-                                        plans.append(Plan(
-                                            mesh=mesh, grad_accum=accum,
-                                            remat=remat, remat_policy=policy,
-                                            zero=zero, grad_compress=compress,
-                                            comm=comm, comm_overlap=ring,
-                                            attention=attention, dtype=dtype))
+                                        for pg in paged_options:
+                                            for kv_dt in kv_dtype_options:
+                                                if kv_dt == "int8" and not pg:
+                                                    continue
+                                                for w_dt in \
+                                                        weight_dtype_options:
+                                                    plans.append(Plan(
+                                                        mesh=mesh,
+                                                        grad_accum=accum,
+                                                        remat=remat,
+                                                        remat_policy=policy,
+                                                        zero=zero,
+                                                        grad_compress=compress,
+                                                        comm=comm,
+                                                        comm_overlap=ring,
+                                                        attention=attention,
+                                                        dtype=dtype,
+                                                        paged=pg,
+                                                        kv_dtype=kv_dt,
+                                                        weight_dtype=w_dt))
     return plans

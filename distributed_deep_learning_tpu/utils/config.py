@@ -202,6 +202,14 @@ class Config:
     admission: dict | None = None       # supervised serving: admission-
                                         #   control knobs (--admission
                                         #   "depth=16,itl-p99-ms=200")
+    kv_dtype: str | None = None         # serving: KV-cache storage dtype
+                                        #   bf16|int8 (int8 = per-position
+                                        #   scales in the block pools,
+                                        #   paged engine only; serve/quant)
+    weight_dtype: str | None = None     # serving: decode weight storage
+                                        #   dtype bf16|int8 (per-channel
+                                        #   scales, dequant fused into the
+                                        #   compiled decode matmuls)
     pos_embedding: str = "learned"      # learned | rope (gpt)
     num_kv_heads: int | None = None     # grouped-query attention (gpt)
     label_smoothing: float = 0.0        # token-CE smoothing (LM families)
@@ -498,6 +506,18 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
                         "patience, cool); degrades quality (spec decode "
                         "off, chunk budget down) before shedding, and "
                         "never sheds priority-0 requests")
+    p.add_argument("--kv-dtype", dest="kv_dtype", type=str, default=None,
+                   metavar="DT",
+                   help="serving: KV-cache storage dtype, bf16 or int8 "
+                        "(int8 keeps per-position scales in the block "
+                        "pools — requires --paged; the spec-decode draft "
+                        "pool inherits it; unset = full precision)")
+    p.add_argument("--weight-dtype", dest="weight_dtype", type=str,
+                   default=None, metavar="DT",
+                   help="serving: decode weight storage dtype, bf16 or "
+                        "int8 (per-output-channel scales; dequantization "
+                        "fuses into the compiled decode matmuls, so no "
+                        "full-precision copy exists at rest)")
     p.add_argument("--schedule", dest="lr_schedule",
                    choices=["none", "cosine", "rsqrt", "step"],
                    default="none",
@@ -833,6 +853,20 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         if v and not args.serve:
             raise SystemExit(f"{flag} requires --serve (it extends the "
                              "post-train serving demo)")
+    # serving quantization legality mirrors the engine constructors
+    # (serve/quant.check_dtype + the PagedEngine-only int8 KV rule) so a
+    # bad flag dies at parse time with the flag name, not inside a jit
+    for flag, v in (("--kv-dtype", args.kv_dtype),
+                    ("--weight-dtype", args.weight_dtype)):
+        if v is not None and v not in ("bf16", "int8"):
+            raise SystemExit(f"unknown {flag} {v!r}; choose bf16 or int8 "
+                             "(or leave unset for full precision)")
+    if args.kv_dtype == "int8" and not args.paged:
+        raise SystemExit("--kv-dtype int8 requires --paged: int8 KV "
+                         "stores per-position scales alongside the block "
+                         "pools; the v1 slot table supports bf16 only "
+                         "(the spec-decode draft pool inherits --kv-dtype "
+                         "automatically)")
     return Config(
         num_layers=args.nlayers,
         size=args.size,
@@ -885,6 +919,8 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         reload_watch=args.reload_watch,
         canary_slots=args.canary_slots,
         admission=parse_admission_arg(args.admission),
+        kv_dtype=args.kv_dtype,
+        weight_dtype=args.weight_dtype,
         pos_embedding=args.pos_embedding,
         num_kv_heads=args.num_kv_heads,
         label_smoothing=args.label_smoothing,

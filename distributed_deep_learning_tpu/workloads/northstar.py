@@ -600,16 +600,19 @@ def _gpt_serve(config: Config, state, logger, dataset) -> None:
                        vocab_size=_vocab(dataset), seed=config.seed,
                        prompt_lens=(2, p_hi), new_tokens=(1, new_hi))
     sup_kw = _serve_supervision_kw(config)
+    quant_kw = dict(kv_dtype=config.kv_dtype,
+                    weight_dtype=config.weight_dtype)
     if sup_kw is None:
         out = run_engine(model, params, trace,
                          max_slots=config.max_slots,
-                         prefill_buckets=config.prefill_buckets)
+                         prefill_buckets=config.prefill_buckets,
+                         **quant_kw)
         s = out["stats"]
     else:
         out = run_supervised(model, params, trace,
                              max_slots=config.max_slots,
                              prefill_buckets=config.prefill_buckets,
-                             **sup_kw)
+                             **quant_kw, **sup_kw)
         _log_supervision(logger, out["stats"])
         s = out["stats"]["engine"]
         if s is None:
@@ -657,7 +660,9 @@ def _gpt_serve_paged(config: Config, model, params, logger, dataset,
     engine_kw = dict(max_slots=config.max_slots, max_len=cap,
                      kv_block_size=block,
                      prefill_chunk=min(config.prefill_chunk, cap),
-                     draft_layers=draft, spec_k=config.spec_k)
+                     draft_layers=draft, spec_k=config.spec_k,
+                     kv_dtype=config.kv_dtype,
+                     weight_dtype=config.weight_dtype)
     sup_kw = _serve_supervision_kw(config)
     if sup_kw is None:
         out = run_paged(model, params, trace, **engine_kw)

@@ -21,6 +21,8 @@ Two modes:
     python scripts/serve_bench.py --paged                        # paged
     python scripts/serve_bench.py --paged --draft 1 --spec-k 4 \
         --kv-block-size 16 --prefill-chunk 32 --slo-ttft-ms 500  # full
+    python scripts/serve_bench.py --paged \
+        --kv-dtype int8 --weight-dtype int8            # quantized path
 
 Defaults are CPU-CI sized; see PERFORMANCE.md §Serving for recorded
 numbers and the knob trade-offs.
@@ -102,6 +104,16 @@ def main(argv=None) -> int:
     p.add_argument("--slo-e2e-ms", type=float, default=None)
     p.add_argument("--skip-v1", action="store_true",
                    help="paged mode: skip the v1-engine comparison leg")
+    # --- serving quantization (both modes; int8 KV is paged-only) ---
+    p.add_argument("--kv-dtype", default=None,
+                   help="KV-cache storage dtype: bf16 or int8 (int8 "
+                        "stores per-position scales in the block pools, "
+                        "so it requires --paged; unset = full precision)")
+    p.add_argument("--weight-dtype", default=None,
+                   help="decode weight storage dtype: bf16 or int8 "
+                        "(int8 = per-channel scales, dequantized inside "
+                        "the compiled decode program; unset = full "
+                        "precision)")
     # model geometry (default: CPU-CI-sized, serve/bench.py)
     p.add_argument("--layers", type=int, default=None)
     p.add_argument("--d-model", type=int, default=None)
@@ -122,6 +134,22 @@ def main(argv=None) -> int:
                         "serve_trace.json with --obs; giving a path "
                         "implies --obs)")
     args = p.parse_args(argv)
+
+    # parse-time quantization legality: fail HERE with the flag name,
+    # not minutes later inside an engine constructor
+    from distributed_deep_learning_tpu.serve.quant import SERVE_DTYPES
+
+    for flag, val in (("--kv-dtype", args.kv_dtype),
+                      ("--weight-dtype", args.weight_dtype)):
+        if val is not None and val not in SERVE_DTYPES:
+            p.error(f"unknown {flag} {val!r}; choose from "
+                    f"{'/'.join(SERVE_DTYPES)} (or leave unset for "
+                    "full precision)")
+    if args.kv_dtype == "int8" and not args.paged:
+        p.error("--kv-dtype int8 requires --paged: int8 KV stores "
+                "per-position scales alongside the block pools; the v1 "
+                "slot table supports bf16 only (the spec-decode draft "
+                "pool inherits --kv-dtype automatically)")
 
     telemetry = None
     if args.obs or args.obs_trace:
@@ -172,7 +200,9 @@ def main(argv=None) -> int:
                 kv_block_size=args.kv_block_size,
                 prefill_chunk=args.prefill_chunk,
                 draft_layers=args.draft or None, spec_k=args.spec_k,
-                compare_engine=not args.skip_v1, telemetry=telemetry)
+                compare_engine=not args.skip_v1,
+                kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype,
+                telemetry=telemetry)
         except ValueError as e:
             p.error(f"{e} — shrink the trace (--prompt-max / --new-max "
                     f"/ --shared-prefix-len) or raise --max-len")
@@ -196,6 +226,7 @@ def main(argv=None) -> int:
                         64 if args.new_max is None else args.new_max),
             max_slots=args.max_slots, prefill_buckets=buckets,
             stagger=args.stagger, skip_naive=args.skip_naive,
+            kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype,
             telemetry=telemetry)
         _latency_line("engine", record["engine"].get("latency") or {})
 

@@ -386,6 +386,92 @@ def serving_paged(n_requests=48, max_slots=16):
     return {"section": "serving_paged", "on_tpu": on_tpu, **rec}
 
 
+def serving_quant(n_requests=48, max_slots=16):
+    """Quantized serving hot path at a TPU-shaped geometry (ISSUE 14):
+    the full-precision vs int8-KV+int8-weight A/B on one trace, PLUS the
+    block-table-aware flash-decode Pallas kernel
+    (ops/paged_decode_pallas.py) timed against the gather-then-mask lax
+    reference on the real pools.  On TPU the kernel number is the
+    harvest: scalar-prefetch block indexing replaces the HBM gather, so
+    kernel-vs-lax is a direct read of how much of the decode tick was
+    the gather — and the int8 variant measures whether in-register
+    dequant keeps the 3.5x wire-byte cut free of MXU stalls."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_deep_learning_tpu.ops.paged_decode_pallas import (
+        paged_decode_reference, paged_flash_decode)
+    from distributed_deep_learning_tpu.serve.bench import (
+        quantized_serving_bench)
+    from distributed_deep_learning_tpu.serve.quant import quantize_rows
+
+    on_tpu = jax.default_backend() == "tpu"
+    model_kw = (dict(vocab_size=32768, num_layers=12, d_model=768,
+                     num_heads=12, mlp_dim=3072, max_len=1024)
+                if on_tpu else
+                dict(vocab_size=512, num_layers=2, d_model=128,
+                     num_heads=4, mlp_dim=256, max_len=192))
+    load_kw = (dict(n_requests=n_requests, arrival="poisson", rate=4.0,
+                    prompt_short=(16, 64), prompt_long=(128, 384),
+                    long_frac=0.3, shared_prefix_len=128, shared_frac=0.6,
+                    new_tokens=(16, 128), slo_ttft_ms=500.0,
+                    slo_e2e_ms=5000.0)
+               if on_tpu else
+               dict(n_requests=10))
+    rec = quantized_serving_bench(
+        load_kw=load_kw, model_kw=model_kw,
+        max_slots=max_slots if on_tpu else 4,
+        kv_block_size=32 if on_tpu else 16,
+        prefill_chunk=128 if on_tpu else 32)
+
+    # kernel vs lax reference on pool shapes matching the A/B geometry
+    B = max_slots if on_tpu else 4
+    Hkv = model_kw["num_heads"]
+    D = model_kw["d_model"] // Hkv
+    bs = 32 if on_tpu else 16
+    Bps = (model_kw["max_len"] // bs)
+    N = B * Bps + 1
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(B, Hkv, 1, D)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(N, bs, Hkv, D)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(N, bs, Hkv, D)), jnp.float32)
+    tables = jnp.asarray(
+        rng.permutation(N - 1)[:B * Bps].reshape(B, Bps).astype(np.int32))
+    lens = jnp.asarray(rng.integers(1, Bps * bs + 1, B), jnp.int32)
+    kq, vq = quantize_rows(kp), quantize_rows(vp)
+
+    def timed(fn, *a, **kw):
+        out = jax.block_until_ready(fn(*a, **kw))   # compile
+        reps = 20 if on_tpu else 3
+        t0 = _time.perf_counter()
+        for _ in range(reps):
+            out = jax.block_until_ready(fn(*a, **kw))
+        return out, (_time.perf_counter() - t0) / reps
+
+    interp = None if on_tpu else True    # CPU smoke: interpret mode
+    ref, t_lax = timed(jax.jit(paged_decode_reference), q, kp, vp,
+                       tables, lens)
+    out, t_kern = timed(paged_flash_decode, q, kp, vp, tables, lens,
+                        interpret=interp)
+    outq, t_kern_q = timed(paged_flash_decode, q, kq, vq, tables, lens,
+                           interpret=interp)
+    kernel = {
+        "shapes": {"slots": B, "heads": Hkv, "head_dim": D,
+                   "block_size": bs, "blocks_per_slot": Bps},
+        "lax_reference_ms": round(t_lax * 1e3, 3),
+        "kernel_ms": round(t_kern * 1e3, 3),
+        "kernel_int8_ms": round(t_kern_q * 1e3, 3),
+        "kernel_speedup_vs_lax": round(t_lax / t_kern, 3) if t_kern else None,
+        "max_abs_err_vs_lax": float(jnp.max(jnp.abs(out - ref))),
+        "interpret_mode": bool(interp),
+    }
+    return {"section": "serving_quant", "on_tpu": on_tpu,
+            "kernel": kernel, **rec}
+
+
 def autotune(workload="gpt"):
     """Auto-parallelism planner on real hardware: search the plan lattice
     for a TPU-shaped LM geometry (small-GPT on TPU, toy on CPU smoke) and
@@ -537,8 +623,8 @@ def _record_flash_gate(result: dict) -> None:
 
 SECTIONS = ("flash_block_sweep", "flash_vs_dense", "gqa_speedup",
             "s2d_vs_plain", "batch_sweep", "lm_tokens", "serving",
-            "serving_paged", "autotune", "reshard", "observability",
-            "collectives", "mfu_diag", "lm_sweep")
+            "serving_paged", "serving_quant", "autotune", "reshard",
+            "observability", "collectives", "mfu_diag", "lm_sweep")
 
 
 def _run_section(name: str) -> None:

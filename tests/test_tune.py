@@ -207,12 +207,26 @@ def test_artifact_rejects_foreign_version(tmp_path):
 def test_artifact_rejects_v1_pre_comm_plans(tmp_path):
     # schema v1 artifacts predate the comm/comm_overlap plan axes; they
     # must be rejected for re-search, not silently replayed without them
-    assert artifact_mod.PLAN_SCHEMA_VERSION == 2
+    assert artifact_mod.PLAN_SCHEMA_VERSION == 3
     path = str(tmp_path / "p.plan.json")
     save_plan(path, _plan(), key="k", workload="mlp")
     rec = json.load(open(path))
     rec["version"] = 1
     del rec["plan"]["comm"], rec["plan"]["comm_overlap"]
+    json.dump(rec, open(path, "w"))
+    with pytest.raises(StalePlanError, match="schema version"):
+        load_plan(path)
+
+
+def test_artifact_rejects_v2_pre_quant_plans(tmp_path):
+    # schema v2 artifacts predate the paged/kv_dtype/weight_dtype serving
+    # axes (ISSUE 14); same rule — re-search, never silent replay
+    path = str(tmp_path / "p.plan.json")
+    save_plan(path, _plan(), key="k", workload="mlp")
+    rec = json.load(open(path))
+    rec["version"] = 2
+    for axis in ("paged", "kv_dtype", "weight_dtype"):
+        del rec["plan"][axis]
     json.dump(rec, open(path, "w"))
     with pytest.raises(StalePlanError, match="schema version"):
         load_plan(path)
