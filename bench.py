@@ -518,6 +518,23 @@ def _serve_resilience() -> dict | None:
         seed=int(os.environ.get("BENCH_CHAOS_SEED", "0")))
 
 
+def _fleet_resilience() -> dict | None:
+    """Fleet-tier self-healing drill (ISSUE 15): three router-fronted
+    paged replicas under a shared-prefix Poisson trace with priority
+    classes — replica crash quarantined with zero-loss bit-identical
+    cross-replica replay, straggler health-degraded, router flake
+    survived, and priority preemption spilling low-priority KV to host
+    and resuming it bit-identically (priority 0 never preempted) — the
+    same code path ``scripts/chaos_drill.py --scenario fleet`` exposes.
+    The replica engines survive the whole gauntlet; the surviving max
+    ``decode_compiles`` staying 1 is part of the record."""
+    from distributed_deep_learning_tpu.utils.chaos import (
+        run_fleet_resilience_drill)
+
+    return run_fleet_resilience_drill(
+        seed=int(os.environ.get("BENCH_CHAOS_SEED", "0")))
+
+
 def _autotune() -> dict | None:
     """Auto-parallelism planner (ISSUE 5): search the plan lattice for the
     MLP workload on this box's devices and report best-vs-default measured
@@ -863,6 +880,14 @@ REGRESSION_BANDS: dict[str, tuple[str, float]] = {
     "serve_resilience_recovery_s_v1": ("lower_abs", 5.0),
     "serve_resilience_requests_lost_v1": ("lower_abs", 0.5),
     "serve_resilience_slo_attainment_v1": ("higher", 0.5),
+    # fleet self-healing drill (ISSUE 15): same philosophy, fleet tier —
+    # a replica crash the router needs >3 ticks to see, a failover
+    # replay past 15 s on the tiny drill fleet, or ANY lost request is
+    # a broken chain regardless of history
+    "fleet_detection_ticks_v1": ("lower_abs", 3.0),
+    "fleet_recovery_s_v1": ("lower_abs", 15.0),
+    "fleet_requests_lost_v1": ("lower_abs", 0.5),
+    "fleet_slo_attainment_v1": ("higher", 0.5),
 }
 
 
@@ -1227,6 +1252,34 @@ def main() -> int:
             print(f"bench: serve-resilience section failed "
                   f"({type(exc).__name__}: {exc})", file=sys.stderr)
 
+    # --- fleet resilience: router failover + preemption under faults --------
+    fleet_resilience = None
+    t_fleet = 180 if on_tpu else 150
+    if os.environ.get("BENCH_FLEET_RESILIENCE", "1") != "0" and \
+            _time_left() < t_fleet:
+        print(f"bench: shedding fleet-resilience section "
+              f"({_time_left():.0f}s left)", file=sys.stderr)
+    elif os.environ.get("BENCH_FLEET_RESILIENCE", "1") != "0":
+        try:
+            with _section_timer("fleet_resilience"):
+                fleet_resilience = _fleet_resilience()
+            for bkey, val in (
+                    ("fleet_detection_ticks_v1",
+                     fleet_resilience.get("detection_ticks_max")),
+                    ("fleet_recovery_s_v1",
+                     fleet_resilience.get("recovery_seconds_max")),
+                    ("fleet_requests_lost_v1",
+                     fleet_resilience.get("requests_lost_total")),
+                    ("fleet_slo_attainment_v1",
+                     fleet_resilience.get("slo_attainment"))):
+                if val is not None:
+                    fleet_resilience[bkey.replace("_v1", "_vs_baseline")] = \
+                        round(_vs_baseline(baselines, f"{platform}:{bkey}",
+                                           float(val), base_path), 4)
+        except Exception as exc:
+            print(f"bench: fleet-resilience section failed "
+                  f"({type(exc).__name__}: {exc})", file=sys.stderr)
+
     # --- autotune: planner search vs hand default ---------------------------
     autotune = None
     t_tune = 120 if on_tpu else 60
@@ -1370,6 +1423,7 @@ def main() -> int:
         "serving_quant": serving_quant,
         "resilience": resilience,
         "serve_resilience": serve_resilience,
+        "fleet_resilience": fleet_resilience,
         "autotune": autotune,
         "reshard": reshard,
         "observability": observability,

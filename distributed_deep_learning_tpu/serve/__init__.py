@@ -21,11 +21,15 @@ per-request SLOs (:mod:`.load`).
 
 from distributed_deep_learning_tpu.serve.engine import (PagedEngine,
                                                         ServeEngine)
+from distributed_deep_learning_tpu.serve.fleet import (FleetRouter,
+                                                       ReplicaCrash)
 from distributed_deep_learning_tpu.serve.load import (LoadSpec, make_load,
+                                                      merge_slo_reports,
                                                       slo_report)
 from distributed_deep_learning_tpu.serve.scheduler import (PagedScheduler,
                                                            Request,
                                                            SlotScheduler)
 
 __all__ = ["ServeEngine", "PagedEngine", "Request", "SlotScheduler",
-           "PagedScheduler", "LoadSpec", "make_load", "slo_report"]
+           "PagedScheduler", "LoadSpec", "make_load", "slo_report",
+           "merge_slo_reports", "FleetRouter", "ReplicaCrash"]

@@ -131,6 +131,14 @@ class AdmissionController:
             engine.chunks_per_tick = (
                 1 if self.level >= 2 else engine._base_chunks_per_tick)
 
+    def pressure(self) -> float:
+        """Scalar overload signal in ``[0, 1]`` — the degradation level
+        normalised by its ceiling.  The fleet router folds this into
+        per-replica health: a replica running hot (deep in its ladder)
+        is DEGRADED and deprioritised for new placements even though it
+        is still serving."""
+        return self.level / 3.0
+
     def stats(self) -> dict:
         return {
             "level": self.level,

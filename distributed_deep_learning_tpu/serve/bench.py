@@ -501,3 +501,65 @@ def quantized_serving_bench(*, seed: int = 0,
         "declared_drift_bound": round(drift["declared_bound"], 5),
         "probe_argmax_agreement": drift["probe_argmax_agreement"],
     }
+
+
+#: the fleet bench's priority mix: a quarter interactive (priority 0,
+#: never preempted or shed), half standard, a quarter batch — shared by
+#: bench.py's fleet_resilience section and tpu_validation's
+#: serving_fleet harvest so both tiers measure the same story
+DEFAULT_PRIORITY_CLASSES = ((0, 0.25), (1, 0.5), (2, 0.25))
+
+
+def fleet_serving_bench(*, seed: int = 0, replicas: int = 3,
+                        load_kw: Optional[dict] = None,
+                        model_kw: Optional[dict] = None,
+                        max_slots: int = 4,
+                        kv_block_size: int = 16,
+                        prefill_chunk: int = 32,
+                        telemetry=None) -> dict:
+    """Throughput + routing quality of a :class:`..serve.fleet.
+    FleetRouter` over ``replicas`` paged engines on the shared-prefix
+    Poisson trace with priority classes.
+
+    The record carries fleet tokens/sec, the router's predicted-hit
+    placement total (the prefix-affinity signal actually paying off is
+    visible as per-replica ``prefix_hit_rate`` in the engine stats),
+    and the merged per-priority SLO report."""
+    from distributed_deep_learning_tpu.serve.fleet import FleetRouter
+
+    model, params = build_model(seed, **(model_kw or {}))
+    lk = {**DEFAULT_LOAD,
+          "priority_classes": DEFAULT_PRIORITY_CLASSES,
+          **(load_kw or {})}
+    spec = LoadSpec(**lk)
+    trace = make_load(spec, vocab_size=model.vocab_size, seed=seed)
+    cap = paged_max_len(model.max_len, kv_block_size, False, 0)
+    engines = [PagedEngine(model, params, max_slots=max_slots,
+                           max_len=cap, kv_block_size=kv_block_size,
+                           prefill_chunk=prefill_chunk)
+               for _ in range(replicas)]
+    flt = FleetRouter(engines, telemetry=telemetry)
+    t0 = time.perf_counter()
+    out = flt.run(list(trace))
+    total = time.perf_counter() - t0
+    st = out["stats"]
+    tokens = int(sum(len(v) for v in out["results"].values()))
+    return {
+        "metric": "fleet serving: routed throughput / SLO by priority",
+        "replicas": replicas,
+        "requests": st["requests"],
+        "completed": st["completed"],
+        "requests_lost": st["requests_lost"],
+        "errors": len(out["errors"]),
+        "generated_tokens": tokens,
+        "tokens_per_sec": round(tokens / total, 2) if total else None,
+        "rounds": st["rounds"],
+        "routing": st["routing"],
+        "health": st["health"],
+        "decode_compiles_max": max(
+            v["decode_compiles"] for v in st["per_replica"].values()),
+        "slo_attainment": st["slo"]["slo_attainment"],
+        "slo_by_priority": {
+            p: s["slo_attainment"]
+            for p, s in st["slo"].get("by_priority", {}).items()},
+    }

@@ -29,6 +29,7 @@ of mixed lengths and staggered arrivals.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Callable, Iterable, Optional, Sequence
 
@@ -618,6 +619,25 @@ class ServeEngine:
         return {"results": sched.finished, "errors": errors, "stats": stats}
 
 
+@dataclasses.dataclass
+class _SpillRecord:
+    """A preempted request parked on the host: its scheduler identity
+    plus the slot image needed to resume bit-identically — the token
+    stream, commit watermark, pending (emitted, unfed) token, and the
+    whole-slot KV copy in the pools' at-rest representation.  Draft
+    pools are deliberately NOT captured: a resumed request restarts
+    speculation cold, which only costs acceptance (verification stays
+    exact), never output tokens."""
+
+    request: Request
+    generated: list
+    stream: list
+    committed: int
+    pendtok: int
+    kv: object                       # host pytree, pools' treedef
+    seq: int                         # spill order, FIFO tiebreak
+
+
 class PagedEngine:
     """Paged continuous batching: prefix reuse, chunked prefill,
     speculative decoding — identical greedy outputs, fewer FLOPs.
@@ -660,7 +680,9 @@ class PagedEngine:
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
                  rng=None, donate: Optional[bool] = None,
                  kv_dtype: Optional[str] = None,
-                 weight_dtype: Optional[str] = None):
+                 weight_dtype: Optional[str] = None,
+                 preempt: bool = False,
+                 spill_dir: Optional[str] = None):
         validate_sampling(top_k, top_p)
         quant.check_dtype("kv_dtype", kv_dtype)
         quant.check_dtype("weight_dtype", weight_dtype)
@@ -733,6 +755,18 @@ class PagedEngine:
         self._chunk_prog = CountingJit(self._chunk_impl, **dk)
         self._decode = CountingJit(self._decode_impl, **dk)
         self._copy = CountingJit(self._copy_impl, **ck)
+        if spill_dir is not None and not preempt:
+            raise ValueError("spill_dir requires preempt=True (it is the "
+                             "preemption spill audit directory)")
+        self._preempt = bool(preempt)
+        self.spill_dir = spill_dir
+        if spill_dir is not None:
+            os.makedirs(spill_dir, exist_ok=True)
+        # spill gathers a whole slot WITHOUT donating the pools (they
+        # must survive the read); unspill donates them like every other
+        # pool-updating program
+        self._spill = CountingJit(self._spill_impl)
+        self._unspill = CountingJit(self._unspill_impl, **ck)
         if draft_layers is not None:
             self.draft_lm, self.draft_params = spec_mod.truncated_draft(
                 self.lm, params, draft_layers)
@@ -904,6 +938,21 @@ class PagedEngine:
 
     def _draft_copy_impl(self, dpools, src, dst):
         return paged.copy_block(dpools, src, dst)
+
+    def _spill_impl(self, pools, table):
+        """One slot's whole logical cache in its AT-REST representation
+        (no dequant — an int8 pool spills int8 + scales, so the round
+        trip back through :meth:`_unspill_impl` is bit-exact by
+        construction).  The preemption read path."""
+        return paged.gather_slot(pools, table, 0)
+
+    def _unspill_impl(self, pools, kv, blocks, offsets):
+        """Write a spilled slot image back: positions ``< committed``
+        land in the resumed slot's fresh blocks, everything beyond is
+        routed to trash by the host-built ``blocks`` vector."""
+        kv = jax.tree_util.tree_map_with_path(
+            lambda p, x: x if paged.is_counter(p) else x[0], kv)
+        return paged.scatter_span(pools, kv, blocks, offsets)
 
     # --- host side --------------------------------------------------------
     def _cow(self, src: int, dst: int) -> None:
@@ -1303,20 +1352,122 @@ class PagedEngine:
                                feed_start=plan.feed_start,
                                commit_to=plan.commit_to, is_last=False)
 
+        # --- priority preemption (opt-in): spilled-slot parking lot ----
+        spilled: list[_SpillRecord] = []
+        preempt_count = resume_count = spill_seq = 0
+
+        def preempt_one(head, ev):
+            """Spill ONE victim slot to make room for ``head``.  The
+            victim is the lowest-priority decoding slot strictly below
+            ``head`` (priority 0 is structurally unpreemptable: nothing
+            outranks it), most-progressed first so the evicted work is
+            the cheapest to finish later.  Returns False when no
+            eligible victim exists."""
+            nonlocal preempt_count, spill_seq
+            cands = [i for i in sched.decoding_slots()
+                     if sched.slots[i].request.priority > head.priority]
+            if not cands:
+                return False
+            victim = sorted(
+                cands,
+                key=lambda i: (-sched.slots[i].request.priority,
+                               len(sched.slots[i].generated), i))[0]
+            kv_dev = self._spill(self.pools,
+                                 jnp.asarray(mgr.tables[victim]))
+            kv = jax.tree.map(np.asarray, kv_dev)  # host copy = barrier
+            req, gen = sched.preempt(victim)
+            mgr.release(victim)
+            rec = _SpillRecord(request=req, generated=gen,
+                               stream=stream.pop(victim),
+                               committed=committed.pop(victim),
+                               pendtok=pendtok.pop(victim),
+                               kv=kv, seq=spill_seq)
+            plans.pop(victim, None)
+            spill_seq += 1
+            spilled.append(rec)
+            preempt_count += 1
+            if self.spill_dir is not None:
+                np.savez(os.path.join(
+                    self.spill_dir, f"spill-{req.uid}-{rec.seq}.npz"),
+                    **{f"leaf_{i:05d}": leaf for i, leaf in
+                       enumerate(jax.tree.leaves(kv))})
+            if ev is not None:
+                ev["preempted"].append(req.uid)
+            if recorder is not None:
+                recorder.record("preempt", uid=req.uid, slot=victim,
+                                committed=rec.committed,
+                                by_uid=head.uid)
+            return True
+
+        def resume_one(ev):
+            """Un-park the best spilled request (highest priority, then
+            FIFO) into a free slot: fresh block budget, scatter the
+            committed KV image back, restore the host stream state.
+            Bit-identity holds because every committed position returns
+            in its at-rest representation and greedy decode is batch-
+            invariant.  False when no slot/budget is available."""
+            nonlocal resume_count
+            if not spilled or sched.occupancy >= self.max_slots:
+                return False
+            rec = min(spilled, key=lambda r: (r.request.priority, r.seq))
+            need = self._capacity_len(rec.request)
+            sp0 = paged.SharedPrefix([], None, 0, b"")
+            if not mgr.can_admit(sp0, need):
+                return False
+            idx = sched.restore(rec.request, rec.generated)
+            if idx is None:
+                return False
+            mgr.admit(idx, sp0, need)
+            pidx = np.arange(self.padded_len)
+            blocks = np.where(pidx < rec.committed,
+                              mgr.tables[idx][pidx // bs],
+                              paged.TRASH).astype(np.int32)
+            offsets = (pidx % bs).astype(np.int32)
+            self.pools = self._unspill(
+                self.pools, jax.tree.map(jnp.asarray, rec.kv),
+                jnp.asarray(blocks), jnp.asarray(offsets))
+            stream[idx] = rec.stream
+            committed[idx] = rec.committed
+            pendtok[idx] = rec.pendtok
+            plans.pop(idx, None)
+            mgr.register_committed(idx, stream[idx], committed[idx])
+            spilled.remove(rec)
+            resume_count += 1
+            if ev is not None:
+                ev["resumed"].append(rec.request.uid)
+            if recorder is not None:
+                recorder.record("resume", uid=rec.request.uid, slot=idx,
+                                committed=rec.committed)
+            return True
+
         t_start = time.perf_counter()
         tick = 0
-        while sched.pending or sched.occupancy:
+        while sched.pending or sched.occupancy or spilled:
             sched.mark_arrivals(tick, time.perf_counter())
             g_queue.set(sched.queue_depth(tick))
             ev = ({"tick": tick, "placed": [], "chunks": [],
-                   "decoded": [], "shed": []} if keep_timeline else None)
+                   "decoded": [], "shed": [], "preempted": [],
+                   "resumed": []} if keep_timeline else None)
 
             # admission: FIFO while a slot AND its whole block budget
             # are available (no partial admission, no pool deadlock);
             # an AdmissionController may shed the head first — placed
             # slots are never touched, so shedding cannot starve them
-            while sched.occupancy < self.max_slots:
+            while True:
+                can_place = sched.occupancy < self.max_slots
+                if not can_place and not self._preempt:
+                    break              # legacy: a full house just waits
                 head = sched.peek(tick)
+                # resume politeness: a parked request was admitted once
+                # already — it outranks any queue head of equal or lower
+                # priority for the next free slot
+                if self._preempt and spilled and can_place:
+                    best = min(spilled,
+                               key=lambda r: (r.request.priority, r.seq))
+                    if head is None or \
+                            best.request.priority <= head.priority:
+                        if resume_one(ev):
+                            continue
                 if head is None:
                     break
                 if admission is not None:
@@ -1331,9 +1482,27 @@ class PagedEngine:
                             recorder.record("shed", uid=shed_req.uid,
                                             reason=reason)
                         continue
+                if not can_place:
+                    # slot pressure: evict a strictly-lower-priority
+                    # victim so the head can take its slot — or stop if
+                    # nothing outranked sits in one
+                    if not preempt_one(head, ev):
+                        break
+                    continue
                 t_adm = time.perf_counter()
                 sp = mgr.match_prefix(head.prompt)
-                if not mgr.can_admit(sp, self._capacity_len(head)):
+                need_ok = mgr.can_admit(sp, self._capacity_len(head))
+                while not need_ok and self._preempt:
+                    # make room by spilling strictly-lower-priority
+                    # slots; each preemption shrinks the victim set, so
+                    # this terminates.  Re-match after every eviction —
+                    # releasing a victim can change the shareable prefix
+                    if not preempt_one(head, ev):
+                        break
+                    sp = mgr.match_prefix(head.prompt)
+                    need_ok = mgr.can_admit(sp,
+                                            self._capacity_len(head))
+                if not need_ok:
                     break              # wait for retirements to free KV
                 idx, req = sched.place(tick)
                 shared = mgr.admit(idx, sp, self._capacity_len(req))
@@ -1369,6 +1538,8 @@ class PagedEngine:
             if not sched.occupancy:
                 nxt = sched.next_arrival()
                 if nxt is None:
+                    if spilled:
+                        continue       # parked work only: resume next pass
                     break
                 tick = max(tick, nxt)  # idle engine: jump to arrival
                 continue
@@ -1606,6 +1777,14 @@ class PagedEngine:
                 "prefill_tokens_computed": chunk_calls * self.chunk,
             },
             "spec": spec_stats,
+            "preempt": {
+                "enabled": self._preempt,
+                "preemptions": preempt_count,
+                "resumes": resume_count,
+                "still_spilled": len(spilled),
+                "spill_compiles": self._spill.traces,
+                "unspill_compiles": self._unspill.traces,
+            },
             "slo": slo_report(accepted, ttft_s, e2e_s),
             "latency": latency,
             "window": live.signals(),

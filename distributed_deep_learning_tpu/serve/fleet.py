@@ -1,0 +1,412 @@
+"""Fleet tier: N supervised engines behind a health-checked router.
+
+"Millions of users" means no single engine is ever the whole story —
+the unit of serving becomes a FLEET of replicas, and the interesting
+failure modes move up a layer: a replica crashing must not lose
+requests, a straggling replica must stop receiving traffic before it
+drags tail latency, and a router blind-spot must degrade placement
+quality, not correctness.  :class:`FleetRouter` drives N
+:class:`..serve.engine.PagedEngine` replicas, each under its own
+:class:`..serve.supervisor.ServeSupervisor`, and owns the three
+fleet-level behaviors:
+
+* **Routing on predicted prefix hits.**  Each replica exports a cheap
+  chain-hash summary of its prefix index
+  (:meth:`..serve.paged.BlockManager.prefix_summary`); the router walks
+  a prompt's block hashes against each summary
+  (:func:`..serve.paged.predict_shared_len`) and places where the most
+  prompt tokens are already cached, tiebreaking on least queue depth
+  then replica id.  Placements feed back into the summary, so requests
+  sharing a system prompt co-locate even before any of them finishes.
+* **Zero-loss failover.**  Replica supervisors run with
+  ``fatal=(ReplicaCrash,)``: a fleet-level crash escalates instead of
+  being contained, the router quarantines the replica, warm-resets its
+  engine (same compiled programs — ``decode_compiles`` stays 1), and
+  replays the crashed replica's in-flight requests from the fleet
+  :class:`..serve.supervisor.RequestLedger` onto healthy replicas.
+  Greedy decode is deterministic and batch/replica-invariant, so the
+  replayed continuations are bit-identical and ``requests_lost == 0``
+  by construction.
+* **Health tracking.**  Heartbeats (per-tick observations through the
+  supervisor's ``fleet_hook``) and supervisor stats drive a three-state
+  health machine — ``healthy`` / ``degraded`` (slow ticks beyond the
+  budget, or deep in the admission ladder) / ``quarantined`` (crashed)
+  — and the router prefers healthy replicas at placement time.
+
+Execution is a ROUND-BASED SIMULATION on one box: per round the router
+places every open request, runs each replica's supervisor to
+completion, then harvests every supervisor ledger into the fleet
+ledger.  That keeps the whole tier deterministic and drillable before
+chips exist; the routing, failover, and health logic are exactly what a
+concurrent deployment would run between ticks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Iterable, Optional
+
+import numpy as np
+
+from distributed_deep_learning_tpu.obs.metrics import MetricsRegistry
+from distributed_deep_learning_tpu.serve import paged
+from distributed_deep_learning_tpu.serve.load import merge_slo_reports
+from distributed_deep_learning_tpu.serve.scheduler import Request
+from distributed_deep_learning_tpu.serve.supervisor import (RequestLedger,
+                                                            ServeSupervisor)
+
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+QUARANTINED = "quarantined"
+
+_HEALTH_CODE = {HEALTHY: 0, DEGRADED: 1, QUARANTINED: 2}
+
+
+class ReplicaCrash(RuntimeError):
+    """A whole replica died (process gone, device wedged) — the fault
+    class a single engine's supervisor cannot contain.  Supervisors in
+    a fleet run with ``fatal=(ReplicaCrash,)`` so it escalates to the
+    router, which owns quarantine + cross-replica replay."""
+
+
+@dataclasses.dataclass
+class _Replica:
+    """Router-side record of one engine replica."""
+
+    rid: int
+    engine: object
+    supervisor_kw: dict
+    health: str = HEALTHY
+    assigned: list = dataclasses.field(default_factory=list)
+    summary: set = dataclasses.field(default_factory=set)
+    ticks: int = 0
+    slow_ticks: int = 0
+    crashes: int = 0
+    placements: int = 0
+    stats: Optional[dict] = None      # last clean supervisor stats
+
+
+def _prompt_hashes(prompt, block_size: int) -> list:
+    """The chain hashes a prompt's full blocks will register under once
+    prefilled — what a placement adds to the routed replica's PREDICTED
+    summary (same ``len - 1`` cap as the real index)."""
+    toks = np.asarray(prompt)
+    L = len(toks)
+    h = b""
+    out = []
+    i = 0
+    while (i + 1) * block_size <= L - 1:
+        h = paged.chain_hash(
+            h, tuple(int(t) for t in toks[i * block_size:
+                                          (i + 1) * block_size]))
+        out.append(h)
+        i += 1
+    return out
+
+
+class FleetRouter:
+    """Health-checked router over N supervised engine replicas.
+
+    ``engines`` share one model geometry (any mix of quantization /
+    speculation settings with identical greedy outputs is fine — greedy
+    continuations must be replica-invariant for failover bit-identity).
+    ``chaos`` is a :class:`..utils.chaos.ChaosPlan` whose fleet kinds
+    fire through the per-replica tick observer (``replica_crash``,
+    ``replica_straggler``) and the placement path (``router_flake``).
+    ``admissions`` optionally maps replica id -> its
+    :class:`..serve.admission.AdmissionController` (each replica needs
+    its own ladder state).
+
+    ``run()`` returns the engines' ``{"results", "errors", "stats"}``
+    contract; ``stats`` adds the fleet record — per-replica health,
+    routing decisions, faults, and a merged per-priority SLO report.
+    """
+
+    def __init__(self, engines, *, chaos=None, deadline_ms=None,
+                 retries: int = 2, max_restarts: int = 8,
+                 stall_timeout_s=None, slow_tick_s: Optional[float] = None,
+                 degrade_after: int = 2, degrade_pressure: float = 0.67,
+                 admissions: Optional[dict] = None, telemetry=None,
+                 recorder=None, clock=time.monotonic):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("FleetRouter needs at least one engine")
+        if retries < 0:
+            raise ValueError(f"retries must be >= 0, got {retries}")
+        if degrade_after < 1:
+            raise ValueError(f"degrade_after must be >= 1, got "
+                             f"{degrade_after}")
+        eos = {e.eos_id for e in engines}
+        if len(eos) != 1:
+            raise ValueError(f"replicas disagree on eos_id: {sorted(map(str, eos))}")
+        self.chaos = chaos
+        self.retries = int(retries)
+        self.slow_tick_s = slow_tick_s
+        self.degrade_after = int(degrade_after)
+        self.degrade_pressure = float(degrade_pressure)
+        self.admissions = dict(admissions or {})
+        self.telemetry = telemetry
+        self.recorder = recorder
+        self._clock = clock
+        sup_kw = dict(deadline_ms=deadline_ms, retries=retries,
+                      max_restarts=max_restarts,
+                      stall_timeout_s=stall_timeout_s)
+        self.replicas = [_Replica(rid=i, engine=e, supervisor_kw=sup_kw)
+                         for i, e in enumerate(engines)]
+        self.ledger = RequestLedger(engines[0].eos_id)
+        self.faults: list[dict] = []
+        self.rounds = 0
+        self.route_seq = 0
+        self.flake_degraded = 0
+        self.predicted_hit_tokens = 0
+        reg = telemetry.registry if telemetry is not None \
+            else MetricsRegistry()
+        self._g_health = {r.rid: reg.gauge("fleet_replica_health",
+                                           replica=str(r.rid))
+                          for r in self.replicas}
+        self._g_assigned = {r.rid: reg.gauge("fleet_replica_assigned",
+                                             replica=str(r.rid))
+                            for r in self.replicas}
+        self._g_ticks = {r.rid: reg.gauge("fleet_replica_ticks",
+                                          replica=str(r.rid))
+                         for r in self.replicas}
+
+    # --- health -----------------------------------------------------------
+    def _observe_tick(self, rep: _Replica, report) -> None:
+        """Per-tick heartbeat from a replica's supervisor (the
+        ``fleet_hook`` seam): fires due fleet chaos, then folds the
+        tick's wall time into the straggler detector."""
+        rep.ticks += 1
+        extra = 0.0
+        if self.chaos is not None:
+            extra = self.chaos.fleet_hook(rep.rid, report)
+        if (self.slow_tick_s is not None
+                and report.elapsed_s + extra > self.slow_tick_s):
+            rep.slow_ticks += 1
+            if (rep.slow_ticks >= self.degrade_after
+                    and rep.health == HEALTHY):
+                rep.health = DEGRADED
+                if self.recorder is not None:
+                    self.recorder.record("replica_degraded",
+                                         replica=rep.rid,
+                                         slow_ticks=rep.slow_ticks)
+
+    def _export_gauges(self) -> None:
+        for rep in self.replicas:
+            self._g_health[rep.rid].set(_HEALTH_CODE[rep.health])
+            self._g_assigned[rep.rid].set(len(rep.assigned))
+            self._g_ticks[rep.rid].set(rep.ticks)
+
+    # --- routing ----------------------------------------------------------
+    def _route_one(self, req: Request, candidates: list) -> _Replica:
+        """Place one request: most predicted prefix-hit tokens wins,
+        healthy replicas outrank degraded ones, queue depth then
+        replica id break ties.  A ``router_flake`` window blanks the
+        hit signal (placement quality degrades; correctness never
+        depends on it)."""
+        flaky = (self.chaos is not None
+                 and self.chaos.route_hook(self.route_seq))
+        self.route_seq += 1
+        if flaky:
+            self.flake_degraded += 1
+        hits = {}
+        for rep in candidates:
+            if flaky:
+                hits[rep.rid] = 0
+            else:
+                hits[rep.rid] = paged.predict_shared_len(
+                    rep.summary, req.prompt, rep.engine.block_size)
+        best = sorted(
+            candidates,
+            key=lambda rep: (0 if rep.health == HEALTHY else 1,
+                             -hits[rep.rid], len(rep.assigned),
+                             rep.rid))[0]
+        self.predicted_hit_tokens += hits[best.rid]
+        best.assigned.append(req)
+        best.placements += 1
+        # feed the placement back: the routed prompt's blocks will be
+        # indexed there, so same-prefix followers co-locate immediately
+        best.summary.update(_prompt_hashes(req.prompt,
+                                           best.engine.block_size))
+        if self.recorder is not None:
+            self.recorder.record("route", uid=req.uid, replica=best.rid,
+                                 predicted_hit=hits[best.rid],
+                                 flaky=flaky)
+        return best
+
+    def _live_candidates(self) -> list:
+        cands = [r for r in self.replicas if r.health != QUARANTINED]
+        if not cands:
+            # total-outage fallback: every replica crashed at least
+            # once.  The engines were warm-reset at quarantine time, so
+            # return them to service DEGRADED rather than losing work.
+            for r in self.replicas:
+                r.health = DEGRADED
+            cands = list(self.replicas)
+            if self.recorder is not None:
+                self.recorder.record("fleet_unquarantine_all")
+        return cands
+
+    # --- replay (fleet ledger -> next round's requests) -------------------
+    def _open_requests(self) -> list:
+        out = []
+        for e in self.ledger.open_entries():
+            r = e.request
+            if e.attempts > self.retries:
+                e.error = (f"retries: request survived {e.attempts - 1} "
+                           f"replica fault(s), exceeding the fleet "
+                           f"retry budget {self.retries}")
+                continue
+            if e.committed:
+                prompt = np.concatenate(
+                    [np.asarray(r.prompt),
+                     np.asarray(e.committed, dtype=r.prompt.dtype)])
+                arrival = 0
+            else:
+                prompt = r.prompt
+                arrival = r.arrival_tick
+            out.append(Request(
+                uid=r.uid, prompt=prompt,
+                max_new_tokens=r.max_new_tokens - len(e.committed),
+                arrival_tick=arrival, slo_ttft_ms=r.slo_ttft_ms,
+                slo_e2e_ms=r.slo_e2e_ms, priority=r.priority))
+        return out
+
+    # --- main loop --------------------------------------------------------
+    def run(self, requests: Iterable[Request]) -> dict:
+        for req in requests:
+            self.ledger.add(req)
+        t_start = self._clock()
+        slo_reports: list[dict] = []
+        errors: dict = {}
+        max_rounds = len(self.replicas) + 2 + self.retries
+
+        while True:
+            todo = self._open_requests()
+            if not todo or self.rounds >= max_rounds:
+                break
+            self.rounds += 1
+            for e in self.ledger.entries.values():
+                if not e.retired and e.error is None:
+                    e.attempts += 1
+            # route this round's work over live replicas, freshest
+            # REAL index summaries first (placement feedback stacks on
+            # top for the requests routed within the round)
+            cands = self._live_candidates()
+            for rep in cands:
+                rep.assigned = []
+                rep.summary = set(rep.engine.manager.prefix_summary())
+            for req in sorted(todo, key=lambda r: (r.arrival_tick,
+                                                   r.uid)):
+                self._route_one(req, cands)
+            self._export_gauges()
+
+            for rep in cands:
+                if not rep.assigned:
+                    continue
+                sup = ServeSupervisor(
+                    rep.engine, chaos=None,
+                    admission=self.admissions.get(rep.rid),
+                    recorder=self.recorder,
+                    fleet_hook=(lambda report, _rep=rep:
+                                self._observe_tick(_rep, report)),
+                    fatal=(ReplicaCrash,), **rep.supervisor_kw)
+                t0 = self._clock()
+                try:
+                    out = sup.run(list(rep.assigned),
+                                  telemetry=self.telemetry)
+                except ReplicaCrash as exc:
+                    rep.crashes += 1
+                    rep.health = QUARANTINED
+                    fault_tick = (sup.faults[-1]["tick"]
+                                  if sup.faults else None)
+                    # warm reset NOW so the replica can return to
+                    # service without retracing (the canary for that is
+                    # decode_compiles staying 1)
+                    rep.engine.reset()
+                    self.faults.append({
+                        "replica": rep.rid,
+                        "kind": type(exc).__name__,
+                        "message": str(exc),
+                        "tick": fault_tick,
+                        "round": self.rounds,
+                        "recovery_s": None,   # filled when replays land
+                        "_t_fault": t0,
+                    })
+                    if self.recorder is not None:
+                        self.recorder.record("replica_quarantined",
+                                             replica=rep.rid,
+                                             tick=fault_tick)
+                    out = None
+                finally:
+                    # EVERY supervisor ledger is harvested — crashed
+                    # rounds contribute the tokens their ticks already
+                    # committed, so replay resumes instead of restarting
+                    for uid, entry in sup.ledger.entries.items():
+                        for tok in entry.committed:
+                            self.ledger.commit(uid, tok)
+                if out is not None:
+                    rep.stats = out["stats"]
+                    slo_reports.append(out["stats"]["engine"]["slo"])
+                    for uid, msg in out["errors"].items():
+                        e = self.ledger.entries.get(uid)
+                        if e is not None and not e.retired \
+                                and e.error is None:
+                            e.error = msg
+                    # admission-ladder pressure marks a hot replica
+                    adm = self.admissions.get(rep.rid)
+                    if (adm is not None and rep.health == HEALTHY
+                            and adm.pressure() >= self.degrade_pressure):
+                        rep.health = DEGRADED
+            # a completed round means every replayed request from prior
+            # faults has landed — close their recovery clocks
+            now = self._clock()
+            for f in self.faults:
+                if f["recovery_s"] is None:
+                    f["recovery_s"] = now - f.pop("_t_fault")
+            self._export_gauges()
+
+        for uid, e in self.ledger.entries.items():
+            if e.error is not None:
+                errors[uid] = e.error
+        results = self.ledger.results()
+        lost = [uid for uid, e in self.ledger.entries.items()
+                if not e.retired and e.error is None]
+        for f in self.faults:                 # never leak the raw clock
+            f.pop("_t_fault", None)
+        stats = {
+            "fleet": True,
+            "replicas": len(self.replicas),
+            "health": {r.rid: r.health for r in self.replicas},
+            "rounds": self.rounds,
+            "requests": len(self.ledger.entries),
+            "completed": len(results),
+            "errored": len(errors),
+            "requests_lost": len(lost),
+            "lost_uids": lost,
+            "faults": self.faults,
+            "total_seconds": self._clock() - t_start,
+            "routing": {
+                "decisions": self.route_seq,
+                "assignments": {r.rid: r.placements
+                                for r in self.replicas},
+                "predicted_hit_tokens": self.predicted_hit_tokens,
+                "flake_degraded": self.flake_degraded,
+            },
+            "per_replica": {
+                r.rid: {
+                    "health": r.health,
+                    "ticks": r.ticks,
+                    "slow_ticks": r.slow_ticks,
+                    "crashes": r.crashes,
+                    "placements": r.placements,
+                    "decode_compiles": r.engine._decode.traces,
+                    "restarts": r.engine.restarts,
+                    "stats": r.stats,
+                } for r in self.replicas},
+            "slo": merge_slo_reports(slo_reports),
+        }
+        for rid, adm in sorted(self.admissions.items()):
+            stats.setdefault("admission", {})[rid] = adm.stats()
+        return {"results": results, "errors": errors, "stats": stats}

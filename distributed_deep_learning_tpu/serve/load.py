@@ -46,6 +46,10 @@ class LoadSpec:
     new_tokens: tuple = (4, 32)   # max_new_tokens range
     slo_ttft_ms: Optional[float] = None   # applied to every request
     slo_e2e_ms: Optional[float] = None
+    #: optional priority mix: ((priority, fraction), ...) — fractions
+    #: must sum to 1.  None keeps every request at the Request default,
+    #: AND keeps the legacy rng draw sequence (traces stay bit-stable).
+    priority_classes: Optional[tuple] = None
 
     def __post_init__(self):
         if self.n_requests < 1:
@@ -56,6 +60,25 @@ class LoadSpec:
             raise ValueError("long_frac must be in [0, 1]")
         if not 0.0 <= self.shared_frac <= 1.0:
             raise ValueError("shared_frac must be in [0, 1]")
+        if self.priority_classes is not None:
+            pcs = tuple(self.priority_classes)
+            if not pcs:
+                raise ValueError("priority_classes must be non-empty "
+                                 "when given")
+            prios = [p for p, _ in pcs]
+            if any(not isinstance(p, int) or isinstance(p, bool) or p < 0
+                   for p in prios):
+                raise ValueError("priority_classes priorities must be "
+                                 "non-negative ints")
+            if len(set(prios)) != len(prios):
+                raise ValueError("priority_classes priorities must be "
+                                 "unique")
+            if any(f < 0 for _, f in pcs):
+                raise ValueError("priority_classes fractions must be "
+                                 ">= 0")
+            if abs(sum(f for _, f in pcs) - 1.0) > 1e-6:
+                raise ValueError("priority_classes fractions must sum "
+                                 "to 1")
 
 
 def _arrival_ticks(spec: LoadSpec, rng: np.random.Generator) -> np.ndarray:
@@ -97,24 +120,26 @@ def make_load(spec: LoadSpec, vocab_size: int, seed: int = 0,
         prompt = toks(plen)
         if spec.shared_prefix_len and rng.random() < spec.shared_frac:
             prompt = np.concatenate([sys_prompt, prompt])
+        new = int(rng.integers(spec.new_tokens[0],
+                               spec.new_tokens[1] + 1))
+        prio = 1                     # the Request default
+        if spec.priority_classes is not None:
+            # drawn LAST so a priority-free spec replays the exact
+            # legacy rng sequence (existing traces stay bit-stable)
+            pcs = spec.priority_classes
+            prio = int(rng.choice([p for p, _ in pcs],
+                                  p=np.asarray([f for _, f in pcs])
+                                  / sum(f for _, f in pcs)))
         reqs.append(Request(
-            uid=uid, prompt=prompt,
-            max_new_tokens=int(rng.integers(spec.new_tokens[0],
-                                            spec.new_tokens[1] + 1)),
+            uid=uid, prompt=prompt, max_new_tokens=new,
             arrival_tick=int(ticks[uid]),
-            slo_ttft_ms=spec.slo_ttft_ms, slo_e2e_ms=spec.slo_e2e_ms))
+            slo_ttft_ms=spec.slo_ttft_ms, slo_e2e_ms=spec.slo_e2e_ms,
+            priority=prio))
     reqs.sort(key=lambda r: (r.arrival_tick, r.uid))
     return reqs
 
 
-def slo_report(requests, ttft_s: dict, e2e_s: dict) -> dict:
-    """Score measured latencies against each request's SLOs.
-
-    ``ttft_s`` / ``e2e_s`` map request uid -> measured seconds; a
-    request missing its measurement counts as a miss (it never finished
-    inside the run).  Requests with no SLO attached are excluded from
-    attainment — ``slo_attainment`` is ``None`` when nothing was
-    checked, so downstream consumers can tell "no SLOs" from "0%"."""
+def _slo_score(requests, ttft_s: dict, e2e_s: dict) -> dict:
     checked = attained = ttft_miss = e2e_miss = 0
     for r in requests:
         has = False
@@ -139,3 +164,60 @@ def slo_report(requests, ttft_s: dict, e2e_s: dict) -> dict:
         "slo_ttft_misses": ttft_miss,
         "slo_e2e_misses": e2e_miss,
     }
+
+
+def slo_report(requests, ttft_s: dict, e2e_s: dict) -> dict:
+    """Score measured latencies against each request's SLOs.
+
+    ``ttft_s`` / ``e2e_s`` map request uid -> measured seconds; a
+    request missing its measurement counts as a miss (it never finished
+    inside the run).  Requests with no SLO attached are excluded from
+    attainment — ``slo_attainment`` is ``None`` when nothing was
+    checked, so downstream consumers can tell "no SLOs" from "0%".
+
+    ``by_priority`` breaks the same score down per priority class
+    (string keys, JSON-stable) — the fleet-tier answer to "did the
+    degradation land on the requests that could afford it"."""
+    requests = list(requests)
+    rep = _slo_score(requests, ttft_s, e2e_s)
+    rep["by_priority"] = {
+        str(p): _slo_score([r for r in requests if r.priority == p],
+                           ttft_s, e2e_s)
+        for p in sorted({r.priority for r in requests})}
+    return rep
+
+
+def merge_slo_reports(reports) -> dict:
+    """Fold per-replica :func:`slo_report` dicts into one fleet-level
+    report: counts sum, attainment is recomputed from the summed counts
+    (NOT averaged — replicas see different request counts), and the
+    ``by_priority`` breakdowns merge class-wise."""
+    reports = [r for r in reports if r]
+    checked = sum(r["slo_checked"] for r in reports)
+    attained = sum(r["slo_attained"] for r in reports)
+    merged = {
+        "slo_checked": checked,
+        "slo_attained": attained,
+        "slo_attainment": (attained / checked) if checked else None,
+        "slo_ttft_misses": sum(r["slo_ttft_misses"] for r in reports),
+        "slo_e2e_misses": sum(r["slo_e2e_misses"] for r in reports),
+    }
+    classes = sorted({p for r in reports
+                      for p in r.get("by_priority", {})})
+    if classes:
+        merged["by_priority"] = {}
+        for p in classes:
+            subs = [r["by_priority"][p] for r in reports
+                    if p in r.get("by_priority", {})]
+            c = sum(s["slo_checked"] for s in subs)
+            a = sum(s["slo_attained"] for s in subs)
+            merged["by_priority"][p] = {
+                "slo_checked": c,
+                "slo_attained": a,
+                "slo_attainment": (a / c) if c else None,
+                "slo_ttft_misses": sum(s["slo_ttft_misses"]
+                                       for s in subs),
+                "slo_e2e_misses": sum(s["slo_e2e_misses"]
+                                      for s in subs),
+            }
+    return merged

@@ -514,6 +514,15 @@ class BlockManager:
         self._chain[slot] = (done, h)
         return added
 
+    def prefix_summary(self) -> frozenset:
+        """Cheap export of this manager's prefix-index coverage: the set
+        of chain hashes currently indexed.  Each hash commits to an
+        entire token prefix (see :func:`chain_hash`), so a router can
+        predict how many prompt tokens would hit this replica's cache
+        without seeing any cached tokens — hand the summary to
+        :func:`predict_shared_len`."""
+        return frozenset(self.index.entries)
+
     def stats(self) -> dict:
         return {
             "blocks_total": self.num_blocks,
@@ -523,3 +532,26 @@ class BlockManager:
             "cow_copies": self.copies,
             "evictions": self.evictions,
         }
+
+
+def predict_shared_len(summary, prompt, block_size: int) -> int:
+    """Predicted prefix-cache hit for ``prompt`` against a replica's
+    :meth:`BlockManager.prefix_summary`: tokens covered by the longest
+    chain of fully-matched blocks.  Mirrors the full-block walk of
+    :meth:`BlockManager.match_prefix` but skips the token-equality
+    re-check and the partial-tail search — the summary carries hashes
+    only, so this is a *prediction* (collision-safe in practice: the
+    chain digest commits to the whole prefix).  Partial-block hits are
+    deliberately ignored; they are at most ``block_size - 1`` tokens."""
+    bs = block_size
+    toks = np.asarray(prompt)
+    L = len(toks)
+    h = b""
+    i = 0
+    while (i + 1) * bs <= L - 1:    # same cap as match_prefix
+        h2 = chain_hash(h, tuple(int(t) for t in toks[i * bs:(i + 1) * bs]))
+        if h2 not in summary:
+            break
+        h = h2
+        i += 1
+    return i * bs

@@ -184,6 +184,33 @@ class SlotScheduler:
         slot.generated = None
         return req
 
+    def preempt(self, slot_idx: int) -> tuple[Request, list]:
+        """Evict a placed request from its slot WITHOUT retiring it,
+        returning ``(request, generated_so_far)`` so the caller can park
+        the pair (KV spilled to host) and later :meth:`restore` it.  The
+        freed slot is immediately placeable; ``arrival_wall`` is left
+        untouched so TTFT/e2e clocks keep running across the gap — a
+        preempted user is still waiting."""
+        slot = self.slots[slot_idx]
+        if not slot.active:
+            raise ValueError(f"slot {slot_idx} is not active")
+        req, gen = slot.request, slot.generated
+        slot.request = None
+        slot.generated = None
+        return req, gen
+
+    def restore(self, request: Request, generated: list) -> Optional[int]:
+        """Re-place a preempted request into the lowest free slot with
+        its generated-token history intact, bypassing the arrival queue
+        (it already waited once).  Returns the slot index, or None when
+        no slot is free."""
+        for i, slot in enumerate(self.slots):
+            if not slot.active:
+                slot.request = request
+                slot.generated = list(generated)
+                return i
+        return None
+
     def last_tokens(self, fill: int = 0) -> np.ndarray:
         """Per-slot feedback tokens for the next decode tick: the slot's
         most recent token, ``fill`` for free slots (their compute is

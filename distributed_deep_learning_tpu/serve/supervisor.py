@@ -150,7 +150,8 @@ class ServeSupervisor:
                  retries: int = 2, max_restarts: int = 8,
                  stall_timeout_s: Optional[float] = None,
                  chaos=None, reload=None, admission=None, recorder=None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, fleet_hook=None,
+                 fatal: tuple = ()):
         if deadline_ms is not None and deadline_ms <= 0:
             raise ValueError(f"deadline_ms must be positive, got "
                              f"{deadline_ms}")
@@ -172,6 +173,15 @@ class ServeSupervisor:
         self.admission = admission
         self.recorder = recorder
         self._clock = clock
+        #: fleet seam: called once per tick with the TickReport, AFTER
+        #: the chaos hook (so fleet-level injections see the same tick a
+        #: router's health tracker observes).  Exceptions propagate like
+        #: engine faults.
+        self.fleet_hook = fleet_hook
+        #: exception types this supervisor must NOT contain: the fault
+        #: is recorded, then re-raised for a higher tier (the fleet
+        #: router) to handle — no restart, no reset.
+        self.fatal = tuple(fatal)
         self.ledger = RequestLedger(engine.eos_id)
         self.faults: list[dict] = []
         self.restarts = 0
@@ -187,6 +197,8 @@ class ServeSupervisor:
         self._last_report = report
         if self.chaos is not None:
             self.chaos.serve_hook(report.engine, report)
+        if self.fleet_hook is not None:
+            self.fleet_hook(report)
         now = self._clock()
         if (self.stall_timeout_s is not None
                 and self._last_beat is not None
@@ -288,6 +300,24 @@ class ServeSupervisor:
                 snap = getattr(exc, "ledger_snapshot", None)
                 if snap is not None:
                     self.ledger.truncate(snap)
+                if isinstance(exc, self.fatal):
+                    # fleet-tier fault: the whole REPLICA is gone, not
+                    # just a tick — record it and escalate.  No restart
+                    # and no reset here; the router owns recovery (it
+                    # harvests this ledger and replays elsewhere).
+                    if self.recorder is not None:
+                        self.recorder.record(
+                            "engine_fault", kind=type(exc).__name__,
+                            message=str(exc), tick=tick, escalated=True)
+                    self.faults.append({
+                        "kind": type(exc).__name__,
+                        "message": str(exc),
+                        "tick": tick,
+                        "recovery_s": None,
+                        "rolled_back": snap is not None,
+                        "escalated": True,
+                    })
+                    raise
                 self.restarts += 1
                 crash_looping = self.restarts > self.max_restarts
                 if self.recorder is not None:

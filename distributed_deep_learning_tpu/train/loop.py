@@ -203,6 +203,7 @@ def _fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
         resume_totals: dict | None = None,
         history_sink: list | None = None,
         sentinel=None, chaos=None, skip_steps=None, *,
+        publish_dir: str | None = None,
         telemetry=None) -> tuple[TrainState, list[EpochResult]]:
     """Drive the epoch loop.  With a ``checkpointer``
     (:class:`..utils.checkpoint.Checkpointer`) the state is saved after
@@ -222,6 +223,12 @@ def _fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
     loader replays ``start_epoch``'s batch order and the first
     ``resume_batch`` batches are skipped — continuation is bit-identical
     to the uninterrupted run.
+
+    ``publish_dir`` (``--publish-weights``) forwards to every
+    :meth:`Checkpointer.save`: each verified save also atomically
+    publishes its params for hot-reloading serving fleets
+    (:mod:`..serve.reload`).  Publishing waits for save durability, so
+    step-cadence saves lose their async overlap when it is on.
 
     ``history_sink`` (a list) receives every EpochResult AS PRODUCED, so a
     caller that catches a mid-run failure still holds the completed
@@ -293,7 +300,8 @@ def _fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
                     gstep, st,
                     extra={"epoch": _epoch, "batch": b,
                            "epoch_complete": False,
-                           "totals": {k: float(v) for k, v in t.items()}})
+                           "totals": {k: float(v) for k, v in t.items()}},
+                    publish_dir=publish_dir)
                 if telemetry is not None:
                     telemetry.timeline.add(
                         "checkpoint", telemetry.timeline.clock() - ck0)
@@ -370,7 +378,8 @@ def _fit(state: TrainState, train_step, eval_step, train_loader, val_loader,
             ck0 = telemetry.timeline.clock() if telemetry else None
             checkpointer.save(step_id, state,
                               extra={"epoch": epoch, "batch": spe,
-                                     "epoch_complete": True})
+                                     "epoch_complete": True},
+                              publish_dir=publish_dir)
             if telemetry is not None:
                 telemetry.timeline.add(
                     "checkpoint", telemetry.timeline.clock() - ck0)

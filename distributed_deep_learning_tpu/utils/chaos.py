@@ -45,6 +45,13 @@ INJECTOR_KINDS = ("ckpt_truncate", "ckpt_bitflip", "fs_error",
 #: here, not train step)
 SERVE_KINDS = ("nan_logits", "stalled_tick", "corrupt_block",
                "engine_crash", "slow_tick")
+#: fleet-tier in-band kinds: ``replica_crash`` / ``replica_straggler``
+#: fire through :meth:`ChaosPlan.fleet_hook` inside a replica's tick
+#: watchdog (``target`` selects the replica id); ``router_flake``
+#: degrades the router's placement signal through
+#: :meth:`ChaosPlan.route_hook` (``step`` means routing SEQUENCE number
+#: there, ``magnitude`` the window width in placements)
+FLEET_KINDS = ("replica_crash", "replica_straggler", "router_flake")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,11 +70,12 @@ class ChaosEvent:
     target: str | int | None = None
 
     def __post_init__(self):
-        if self.kind not in KINDS + SERVE_KINDS:
+        if self.kind not in KINDS + SERVE_KINDS + FLEET_KINDS:
             raise ValueError(f"chaos event kind {self.kind!r}: in-band "
-                             f"kinds are {KINDS} (train) and "
-                             f"{SERVE_KINDS} (serve; use the static "
-                             f"injectors for {INJECTOR_KINDS})")
+                             f"kinds are {KINDS} (train), "
+                             f"{SERVE_KINDS} (serve) and {FLEET_KINDS} "
+                             f"(fleet; use the static injectors for "
+                             f"{INJECTOR_KINDS})")
         if self.step < 1:
             raise ValueError(f"chaos event step must be >= 1, got "
                              f"{self.step}")
@@ -246,6 +254,65 @@ class ChaosPlan:
             return leaf.at[slot].set(value)
 
         engine.slots = jax.tree.map(poison, engine.slots)
+
+    # -- fleet-tier in-band hooks -------------------------------------------
+    def fleet_hook(self, rid: int, report) -> float:
+        """Apply every due fleet fault to replica ``rid`` at this tick.
+
+        Called by the :class:`..serve.fleet.FleetRouter`'s per-replica
+        tick observer.  ``target`` narrows an event to one replica id
+        (None hits whichever replica ticks first).  ``replica_crash``
+        raises :class:`..serve.fleet.ReplicaCrash` — the FATAL kind the
+        replica's supervisor escalates instead of containing;
+        ``replica_straggler`` returns extra virtual seconds
+        (``magnitude``, default 1.0) the health tracker adds to the
+        tick's wall time.  One-shot, recorded in ``fired``."""
+        extra = 0.0
+        for i, ev in enumerate(self.events):
+            if (i in self._done
+                    or ev.kind not in ("replica_crash",
+                                       "replica_straggler")
+                    or ev.step > report.tick):
+                continue
+            if ev.target is not None and int(ev.target) != int(rid):
+                continue
+            self._done.add(i)
+            self.fired.append((report.tick, ev.kind))
+            if self.recorder is not None:
+                self.recorder.record("chaos_fired", step=report.tick,
+                                     fault=ev.kind, replica=int(rid))
+            if ev.kind == "replica_crash":
+                from distributed_deep_learning_tpu.serve.fleet import (
+                    ReplicaCrash)
+
+                raise ReplicaCrash(
+                    f"injected replica crash on replica {rid} at tick "
+                    f"{report.tick}")
+            extra += ev.magnitude or 1.0
+        return extra
+
+    def route_hook(self, seq: int) -> bool:
+        """True while a ``router_flake`` window covers routing decision
+        ``seq`` — the router must place WITHOUT its prefix-hit signal
+        (health and queue depth only).  The window spans
+        ``[step, step + magnitude)`` placements (width default 4);
+        ``fired`` records the first placement it degrades."""
+        flaky = False
+        for i, ev in enumerate(self.events):
+            if i in self._done or ev.kind != "router_flake":
+                continue
+            width = int(ev.magnitude) or 4
+            if seq >= ev.step + width:
+                self._done.add(i)          # window passed, stop scanning
+                continue
+            if seq >= ev.step:
+                if (ev.step, ev.kind) not in self.fired:
+                    self.fired.append((ev.step, ev.kind))
+                    if self.recorder is not None:
+                        self.recorder.record("chaos_fired", step=ev.step,
+                                             fault=ev.kind)
+                flaky = True
+        return flaky
 
     # -- out-of-band injectors ---------------------------------------------
     @staticmethod
@@ -797,4 +864,213 @@ def run_serve_resilience_drill(seed: int = 0) -> dict:
         and final_stats["decode_compiles"] == 1
         and record["slo_attainment_clean"]
         >= record["slo_attainment_faulted"])
+    return record
+
+
+def run_fleet_resilience_drill(seed: int = 0) -> dict:
+    """Exercise the FLEET tier end to end; return the
+    ``fleet_resilience`` record ``bench.py`` reports.
+
+    THREE small :class:`..serve.engine.PagedEngine` replicas survive the
+    whole gauntlet — every scenario reuses them (a crashed replica is
+    warm-reset by the router), so ``decode_compiles`` staying at 1 per
+    surviving replica is itself evidence that quarantine, failover and
+    replay all reuse the compiled programs.  Sections:
+
+    1. **clean** — the no-fault fleet reference outputs every fault
+       scenario must reproduce bit-identically, plus the per-priority
+       SLO report the bench baselines track.
+    2. **replica_crash** — kill replica 1 mid-round under the
+       shared-prefix Poisson trace: the router quarantines it, replays
+       its in-flight requests from the fleet ledger onto the survivors;
+       ``requests_lost == 0`` and greedy outputs bit-identical.
+    3. **replica_straggler** — slow ticks on replica 2 push it to
+       DEGRADED (deprioritised for placement) without losing or
+       corrupting anything.
+    4. **router_flake** — a window of placements loses the prefix-hit
+       signal: placement quality degrades, correctness does not.
+    5. **preemption** — a separate 2-slot engine under priority
+       pressure: high-priority arrivals spill the lowest-priority
+       slots' KV to host and resume them later; preempted-then-resumed
+       outputs are bit-identical to uncontended runs and priority 0 is
+       never preempted (timeline-asserted).
+    """
+    from distributed_deep_learning_tpu.serve.bench import (
+        DEFAULT_PRIORITY_CLASSES, build_model, paged_max_len)
+    from distributed_deep_learning_tpu.serve.engine import PagedEngine
+    from distributed_deep_learning_tpu.serve.fleet import (FleetRouter,
+                                                           QUARANTINED)
+    from distributed_deep_learning_tpu.serve.load import LoadSpec, make_load
+    from distributed_deep_learning_tpu.serve.scheduler import Request
+
+    model_kw = dict(vocab_size=128, num_layers=1, d_model=64, num_heads=2,
+                    mlp_dim=128, max_len=96)
+    model, params = build_model(seed, **model_kw)
+    cap = paged_max_len(model.max_len, 8, False, 0)
+    engines = [PagedEngine(model, params, max_slots=4, max_len=cap,
+                           kv_block_size=8, prefill_chunk=16)
+               for _ in range(3)]
+    spec = LoadSpec(n_requests=14, arrival="poisson", rate=2.0,
+                    prompt_short=(4, 12), prompt_long=(16, 24),
+                    long_frac=0.25, shared_prefix_len=16, shared_frac=0.5,
+                    new_tokens=(6, 14), slo_ttft_ms=30000.0,
+                    slo_e2e_ms=30000.0,
+                    priority_classes=DEFAULT_PRIORITY_CLASSES)
+    trace = make_load(spec, vocab_size=model.vocab_size, seed=seed)
+
+    def fleet(chaos=None, **kw):
+        return FleetRouter(engines, chaos=chaos, **kw)
+
+    ref = fleet().run(list(trace))
+    if ref["errors"] or ref["stats"]["requests_lost"]:
+        raise RuntimeError(
+            f"fleet reference run incomplete: errors {ref['errors']}, "
+            f"lost {ref['stats']['lost_uids']}")
+
+    def identical(out):
+        return (set(out["results"]) == set(ref["results"]) and all(
+            np.array_equal(out["results"][u], ref["results"][u])
+            for u in ref["results"]))
+
+    record: dict = {
+        "metric": ("fleet self-healing: detection ticks / recovery "
+                   "seconds / requests lost / SLO by priority under "
+                   "replica faults"),
+        "model": model_kw, "replicas": 3, "requests": len(trace),
+        "scenarios": {},
+    }
+    detect, recover = [], []
+    lost_total = 0
+    all_ok = True
+
+    # --- 2. replica crash: quarantine + zero-loss bit-identical replay ----
+    plan = ChaosPlan([ChaosEvent(step=3, kind="replica_crash", target=1)],
+                     seed=seed)
+    out = fleet(chaos=plan).run(list(trace))
+    st = out["stats"]
+    fired_tick = plan.fired[0][0] if plan.fired else None
+    fault = st["faults"][0] if st["faults"] else None
+    det = (fault["tick"] - fired_tick
+           if fault and fired_tick is not None
+           and fault["tick"] is not None else None)
+    surviving_compiles = [v["decode_compiles"]
+                          for r, v in st["per_replica"].items() if r != 1]
+    ok = (identical(out) and st["requests_lost"] == 0
+          and not out["errors"] and st["health"][1] == QUARANTINED
+          and det is not None
+          and all(c == 1 for c in surviving_compiles))
+    record["scenarios"]["replica_crash"] = {
+        "fired": list(plan.fired),
+        "detection_ticks": det,
+        "recovery_s": (round(fault["recovery_s"], 3) if fault else None),
+        "health": dict(st["health"]),
+        "rounds": st["rounds"],
+        "requests_lost": st["requests_lost"],
+        "decode_compiles_surviving": surviving_compiles,
+        "bit_identical": identical(out),
+        "passed": ok,
+    }
+    all_ok = all_ok and ok
+    lost_total += st["requests_lost"]
+    if det is not None:
+        detect.append(det)
+    if fault is not None and fault["recovery_s"] is not None:
+        recover.append(fault["recovery_s"])
+
+    # --- 3. straggler: degraded, deprioritised, still correct -------------
+    plan = ChaosPlan([ChaosEvent(step=2, kind="replica_straggler",
+                                 target=2, magnitude=5.0)], seed=seed)
+    out = fleet(chaos=plan, slow_tick_s=1.0, degrade_after=1).run(
+        list(trace))
+    st = out["stats"]
+    ok = (identical(out) and st["requests_lost"] == 0
+          and not out["errors"] and st["health"][2] == "degraded"
+          and bool(plan.fired))
+    record["scenarios"]["replica_straggler"] = {
+        "fired": list(plan.fired),
+        "health": dict(st["health"]),
+        "slow_ticks": st["per_replica"][2]["slow_ticks"],
+        "requests_lost": st["requests_lost"],
+        "bit_identical": identical(out),
+        "passed": ok,
+    }
+    all_ok = all_ok and ok
+    lost_total += st["requests_lost"]
+
+    # --- 4. router flake: blind placement degrades quality, not truth -----
+    plan = ChaosPlan([ChaosEvent(step=1, kind="router_flake",
+                                 magnitude=6.0)], seed=seed)
+    out = fleet(chaos=plan).run(list(trace))
+    st = out["stats"]
+    ok = (identical(out) and st["requests_lost"] == 0
+          and not out["errors"]
+          and st["routing"]["flake_degraded"] > 0)
+    record["scenarios"]["router_flake"] = {
+        "fired": list(plan.fired),
+        "flake_degraded": st["routing"]["flake_degraded"],
+        "requests_lost": st["requests_lost"],
+        "bit_identical": identical(out),
+        "passed": ok,
+    }
+    all_ok = all_ok and ok
+    lost_total += st["requests_lost"]
+
+    # --- 5. preemption: KV spill/resume bit-identity + priority-0 shield --
+    rng = np.random.default_rng((seed, 99))
+
+    def _preq(uid, prio, arr):
+        return Request(
+            uid=uid,
+            prompt=rng.integers(1, model.vocab_size,
+                                size=8).astype(np.int64),
+            max_new_tokens=10, arrival_tick=arr, priority=prio)
+
+    preqs = [_preq(0, 2, 0), _preq(1, 2, 0), _preq(2, 0, 2),
+             _preq(3, 1, 2)]
+    pref = {}
+    for r in preqs:
+        solo = PagedEngine(model, params, max_slots=2, max_len=48,
+                           kv_block_size=8, prefill_chunk=8)
+        pref[r.uid] = solo.run([Request(uid=r.uid, prompt=r.prompt,
+                                        max_new_tokens=r.max_new_tokens)
+                                ])["results"][r.uid]
+    peng = PagedEngine(model, params, max_slots=2, max_len=48,
+                       kv_block_size=8, prefill_chunk=8, preempt=True)
+    pout = peng.run(list(preqs), keep_timeline=True)
+    ps = pout["stats"]["preempt"]
+    preempted_uids = [u for ev in pout["timeline"]
+                      for u in ev["preempted"]]
+    prio0 = {r.uid for r in preqs if r.priority == 0}
+    pre_identical = all(
+        pout["results"].get(u) is not None
+        and np.array_equal(pout["results"][u], pref[u]) for u in pref)
+    ok = (pre_identical and ps["preemptions"] > 0 and ps["resumes"] > 0
+          and ps["still_spilled"] == 0 and not pout["errors"]
+          and not (set(preempted_uids) & prio0)
+          and pout["stats"]["decode_compiles"] == 1)
+    record["scenarios"]["preemption"] = {
+        "preemptions": ps["preemptions"],
+        "resumes": ps["resumes"],
+        "still_spilled": ps["still_spilled"],
+        "preempted_uids": preempted_uids,
+        "priority0_preempted": sorted(set(preempted_uids) & prio0),
+        "bit_identical": pre_identical,
+        "decode_compiles": pout["stats"]["decode_compiles"],
+        "passed": ok,
+    }
+    all_ok = all_ok and ok
+
+    record["detection_ticks_max"] = max(detect) if detect else None
+    record["recovery_seconds_max"] = (round(max(recover), 3)
+                                      if recover else None)
+    record["requests_lost_total"] = lost_total
+    record["decode_compiles"] = max(
+        v["decode_compiles"]
+        for v in ref["stats"]["per_replica"].values())
+    record["slo_attainment"] = ref["stats"]["slo"]["slo_attainment"]
+    record["slo_by_priority"] = {
+        p: s["slo_attainment"]
+        for p, s in ref["stats"]["slo"].get("by_priority", {}).items()}
+    record["drill_passed"] = bool(
+        all_ok and lost_total == 0 and record["decode_compiles"] == 1)
     return record

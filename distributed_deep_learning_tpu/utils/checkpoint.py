@@ -111,7 +111,8 @@ class Checkpointer:
     # -- save ---------------------------------------------------------------
     def save(self, step: int, state: TrainState, *, force: bool = False,
              wait: bool = False, extra: dict | None = None,
-             manifest: bool = True) -> bool:
+             manifest: bool = True,
+             publish_dir: str | None = None) -> bool:
         """Persist `state` under `step`.  Async by default (the save runs
         while training continues); `wait` blocks until durable.
 
@@ -134,7 +135,15 @@ class Checkpointer:
         checksum/finiteness manifest sidecar — the integrity record
         restores verify against.  Like ``extra`` it is written BEFORE the
         orbax save (a finalised step always has its manifest; a kill in
-        between leaves an orphan the GC collects)."""
+        between leaves an orphan the GC collects).
+
+        ``publish_dir`` (``--publish-weights``) additionally publishes
+        the state's params to that directory in the
+        :func:`..serve.reload.publish_weights` manifest format, for
+        serving fleets watching it (``--reload-watch``) to hot-swap.
+        Publishing happens AFTER the orbax save is durable (it forces a
+        ``wait_until_finished``): only weights that a restart could also
+        restore are ever offered to live engines."""
         if step in set(self._mgr.all_steps()):
             if not force:
                 if wait:
@@ -168,6 +177,14 @@ class Checkpointer:
             step, args=ocp.args.StandardSave(_as_pytree(state)), force=force)
         if jax.process_index() == 0:
             self._gc_sidecars(protect=step)
+        if saved and publish_dir is not None:
+            # durability gate: never offer weights to live engines that a
+            # restart could not also restore
+            self._mgr.wait_until_finished()
+            if jax.process_index() == 0:
+                from distributed_deep_learning_tpu.serve import reload
+
+                reload.publish_weights(publish_dir, step, state.params)
         if wait:
             self._mgr.wait_until_finished()
         return saved

@@ -210,6 +210,18 @@ class Config:
                                         #   dtype bf16|int8 (per-channel
                                         #   scales, dequant fused into the
                                         #   compiled decode matmuls)
+    replicas: int = 1                   # fleet serving: paged-engine
+                                        #   replicas behind the prefix-
+                                        #   affinity router (serve/fleet.py)
+    priority_classes: tuple | None = None  # fleet serving: priority mix
+                                        #   ((prio, frac), ...) parsed from
+                                        #   --priority-classes "0=0.25,..."
+    spill_dir: str | None = None        # fleet serving: host directory for
+                                        #   preempted-slot KV spill files
+                                        #   (engine preemption audit trail)
+    publish_weights: str | None = None  # checkpointing: atomically publish
+                                        #   verified saves for serving hot
+                                        #   reload (serve/reload.py)
     pos_embedding: str = "learned"      # learned | rope (gpt)
     num_kv_heads: int | None = None     # grouped-query attention (gpt)
     label_smoothing: float = 0.0        # token-CE smoothing (LM families)
@@ -518,6 +530,34 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
                         "int8 (per-output-channel scales; dequantization "
                         "fuses into the compiled decode matmuls, so no "
                         "full-precision copy exists at rest)")
+    p.add_argument("--replicas", type=int, default=1, metavar="N",
+                   help="fleet serving: run N paged-engine replicas "
+                        "behind the health-checked prefix-affinity "
+                        "router (serve/fleet.py) — crash-quarantine with "
+                        "zero-loss cross-replica replay; requires "
+                        "--paged when N > 1")
+    p.add_argument("--priority-classes", dest="priority_classes",
+                   type=str, default=None, metavar="P=F,...",
+                   help="fleet serving: request priority mix, e.g. "
+                        "'0=0.25,1=0.5,2=0.25' (priority=fraction, "
+                        "fractions sum to 1); under slot/block pressure "
+                        "higher-priority arrivals preempt the lowest-"
+                        "priority slots (KV spilled to host, resumed "
+                        "bit-identically); priority 0 is never "
+                        "preempted or shed; requires --paged")
+    p.add_argument("--spill-dir", dest="spill_dir", type=str,
+                   default=None, metavar="DIR",
+                   help="fleet serving: also write each preempted "
+                        "slot's spilled KV to DIR as an npz audit "
+                        "trail (resume itself stays in host memory); "
+                        "requires --priority-classes")
+    p.add_argument("--publish-weights", dest="publish_weights", type=str,
+                   default=None, metavar="DIR",
+                   help="checkpointing: after each verified save, "
+                        "atomically publish the params to DIR in the "
+                        "serve/reload.py manifest format, so serving "
+                        "fleets watching it (--reload-watch) hot-swap "
+                        "the new weights; requires --checkpoint-dir")
     p.add_argument("--schedule", dest="lr_schedule",
                    choices=["none", "cosine", "rsqrt", "step"],
                    default="none",
@@ -686,6 +726,49 @@ def parse_admission_arg(text: str | None,
             raise SystemExit(f"{flag}: {key}={val!r} must be >= {lo}")
         out[name] = v
     return out
+
+
+def parse_priority_classes(text: str | None,
+                           flag: str = "--priority-classes"
+                           ) -> tuple | None:
+    """``--priority-classes`` string → ``LoadSpec.priority_classes``
+    tuple, validated at parse time (mirrors :func:`parse_admission_arg`).
+    Example: ``"0=0.25,1=0.5,2=0.25"`` → ``((0, 0.25), (1, 0.5),
+    (2, 0.25))``."""
+    if not text:
+        return None
+    out: list[tuple[int, float]] = []
+    seen: set[int] = set()
+    for part in text.split(","):
+        key, _, val = part.strip().partition("=")
+        if not val:
+            raise SystemExit(f"{flag}: bad entry {part!r}; expected "
+                             "'<priority>=<fraction>', e.g. '0=0.25'")
+        try:
+            prio = int(key)
+        except ValueError:
+            raise SystemExit(f"{flag}: priority {key!r} is not an "
+                             "integer") from None
+        if prio < 0:
+            raise SystemExit(f"{flag}: priority {prio} must be >= 0 "
+                             "(0 is the most-protected class)")
+        if prio in seen:
+            raise SystemExit(f"{flag}: priority {prio} given twice")
+        seen.add(prio)
+        try:
+            frac = float(val)
+        except ValueError:
+            raise SystemExit(f"{flag}: fraction {val!r} for priority "
+                             f"{prio} is not a number") from None
+        if frac < 0:
+            raise SystemExit(f"{flag}: fraction {frac} for priority "
+                             f"{prio} must be >= 0")
+        out.append((prio, frac))
+    total = sum(f for _, f in out)
+    if abs(total - 1.0) > 1e-6:
+        raise SystemExit(f"{flag}: fractions must sum to 1, got "
+                         f"{total:g}")
+    return tuple(out)
 
 
 def parse_mesh_arg(text: str | None,
@@ -861,6 +944,26 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         if v is not None and v not in ("bf16", "int8"):
             raise SystemExit(f"unknown {flag} {v!r}; choose bf16 or int8 "
                              "(or leave unset for full precision)")
+    if args.replicas < 1:
+        raise SystemExit(f"--replicas {args.replicas}: must be >= 1 "
+                         "(1 = a single un-routed engine)")
+    if args.replicas > 1 and not args.paged:
+        raise SystemExit("--replicas > 1 requires --paged (the fleet "
+                         "router's prefix-affinity placement and "
+                         "zero-loss failover replay are built on the "
+                         "paged engine's prefix index and ledger)")
+    if args.priority_classes and not args.paged:
+        raise SystemExit("--priority-classes requires --paged "
+                         "(priority preemption spills and resumes "
+                         "paged KV blocks)")
+    if args.spill_dir and not args.priority_classes:
+        raise SystemExit("--spill-dir requires --priority-classes "
+                         "(spill files are only written when "
+                         "preemption can fire)")
+    if args.publish_weights and not args.checkpoint_dir:
+        raise SystemExit("--publish-weights requires --checkpoint-dir "
+                         "(only verified checkpoint saves are "
+                         "published for serving reload)")
     if args.kv_dtype == "int8" and not args.paged:
         raise SystemExit("--kv-dtype int8 requires --paged: int8 KV "
                          "stores per-position scales alongside the block "
@@ -921,6 +1024,10 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         admission=parse_admission_arg(args.admission),
         kv_dtype=args.kv_dtype,
         weight_dtype=args.weight_dtype,
+        replicas=args.replicas,
+        priority_classes=parse_priority_classes(args.priority_classes),
+        spill_dir=args.spill_dir,
+        publish_weights=args.publish_weights,
         pos_embedding=args.pos_embedding,
         num_kv_heads=args.num_kv_heads,
         label_smoothing=args.label_smoothing,

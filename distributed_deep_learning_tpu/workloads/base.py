@@ -736,7 +736,9 @@ def _run_spmd_pipelined(spec: WorkloadSpec, config: Config, devices, logger,
                        checkpointer=ckpt, start_epoch=start_epoch,
                        checkpoint_every=config.checkpoint_every,
                        resume_batch=resume_batch,
-                       resume_totals=resume_totals, telemetry=telemetry)
+                       resume_totals=resume_totals,
+                       publish_dir=config.publish_weights,
+                       telemetry=telemetry)
     finally:
         if ckpt is not None:
             ckpt.close()
@@ -1063,6 +1065,7 @@ def _run_workload(spec: WorkloadSpec, config: Config, devices, logger,
                            checkpoint_every=config.checkpoint_every,
                            resume_batch=resume_batch,
                            resume_totals=resume_totals, sentinel=sentinel,
+                           publish_dir=config.publish_weights,
                            telemetry=telemetry)
         finally:
             if ckpt is not None:

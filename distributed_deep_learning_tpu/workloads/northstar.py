@@ -663,6 +663,19 @@ def _gpt_serve_paged(config: Config, model, params, logger, dataset,
                      draft_layers=draft, spec_k=config.spec_k,
                      kv_dtype=config.kv_dtype,
                      weight_dtype=config.weight_dtype)
+    if config.priority_classes:
+        # seeded priority mix over the same trace (mirrors
+        # LoadSpec.priority_classes) + engine-side preemption so the
+        # mix has teeth: low-priority slots spill under pressure
+        pcs = config.priority_classes
+        rng = np.random.default_rng(config.seed)
+        fr = np.asarray([f for _, f in pcs]) / sum(f for _, f in pcs)
+        trace = [dataclasses.replace(r, priority=int(
+            rng.choice([p for p, _ in pcs], p=fr))) for r in trace]
+        engine_kw.update(preempt=True, spill_dir=config.spill_dir)
+    if config.replicas > 1:
+        _gpt_serve_fleet(config, model, params, logger, trace, engine_kw)
+        return
     sup_kw = _serve_supervision_kw(config)
     if sup_kw is None:
         out = run_paged(model, params, trace, **engine_kw)
@@ -686,6 +699,44 @@ def _gpt_serve_paged(config: Config, model, params, logger, dataset,
         line += f", spec acceptance {sp['acceptance_rate']:.3f}"
     if slo["slo_attainment"] is not None:
         line += f", slo attainment {slo['slo_attainment']:.2f}"
+    logger.info(line)
+
+
+def _gpt_serve_fleet(config: Config, model, params, logger, trace,
+                     engine_kw: dict) -> None:
+    """``--serve --paged --replicas N``: the same trace through N
+    supervised paged replicas behind the prefix-affinity fleet router
+    (serve/fleet.py) — crash quarantine, zero-loss replay, per-priority
+    SLO rollup."""
+    from distributed_deep_learning_tpu.serve.admission import (
+        AdmissionController)
+    from distributed_deep_learning_tpu.serve.engine import PagedEngine
+    from distributed_deep_learning_tpu.serve.fleet import FleetRouter
+
+    engines = [PagedEngine(model, params, **engine_kw)
+               for _ in range(config.replicas)]
+    admissions = None
+    if config.admission is not None:
+        admissions = {i: AdmissionController(**config.admission)
+                      for i in range(config.replicas)}
+    flt = FleetRouter(engines, deadline_ms=config.serve_deadline_ms,
+                      retries=config.serve_retries, admissions=admissions)
+    out = flt.run(list(trace))
+    st = out["stats"]
+    tokens = sum(len(v) for v in out["results"].values())
+    line = (f"serve(fleet): {st['requests']} requests over "
+            f"{len(engines)} replicas, {tokens} tokens, rounds="
+            f"{st['rounds']}, lost={st['requests_lost']}, predicted hit "
+            f"tokens {st['routing']['predicted_hit_tokens']}, compiles "
+            f"decode={max(v['decode_compiles'] for v in st['per_replica'].values())}")
+    slo = st["slo"]
+    if slo.get("slo_attainment") is not None:
+        line += f", slo attainment {slo['slo_attainment']:.2f}"
+        bp = slo.get("by_priority") or {}
+        if bp:
+            line += " (" + ", ".join(
+                f"p{p}={s['slo_attainment']:.2f}" for p, s in
+                sorted(bp.items()) if s["slo_attainment"] is not None) + ")"
     logger.info(line)
 
 

@@ -1,7 +1,7 @@
 """Chaos drill: rehearse the detect→contain→recover chain, print one JSON
 line.
 
-Three scenarios, selected with ``--scenario``:
+Four scenarios, selected with ``--scenario``:
 
 * ``resilience`` (default) runs
   :func:`distributed_deep_learning_tpu.utils.chaos.run_resilience_drill`
@@ -25,15 +25,25 @@ Three scenarios, selected with ``--scenario``:
   canary rollback with replay, bit-flipped publication rejected by the
   integrity manifest) — all on ONE engine whose ``decode_compiles``
   stays 1 throughout.
+* ``fleet`` runs
+  :func:`distributed_deep_learning_tpu.utils.chaos.run_fleet_resilience_drill`
+  — three router-fronted paged replicas under a shared-prefix Poisson
+  trace with priority classes: a replica killed mid-decode is
+  quarantined and its in-flight requests replayed bit-identically onto
+  the survivors (zero lost), a straggling replica is health-degraded,
+  a flaky router loses its placement signal without losing
+  correctness, and priority preemption spills low-priority KV to host
+  and resumes it bit-identically (priority 0 never preempted).
 
 All are CPU-runnable (the chains are host+XLA logic, not
 accelerator-specific); ``bench.py`` embeds the same records as its
-``resilience``, ``reshard`` and ``serve_resilience`` sections.
+``resilience``, ``reshard``, ``serve_resilience`` and
+``fleet_resilience`` sections.
 
 Usage::
 
     python scripts/chaos_drill.py [--seed N]
-        [--scenario resilience|shrink|serve]
+        [--scenario resilience|shrink|serve|fleet]
 """
 
 import argparse
@@ -49,12 +59,16 @@ def main() -> int:
     p.add_argument("--seed", type=int, default=0,
                    help="chaos plan seed (same seed = same faults, "
                         "bit-identical poison masks / kill sets)")
-    p.add_argument("--scenario", choices=("resilience", "shrink", "serve"),
+    p.add_argument("--scenario", choices=("resilience", "shrink", "serve",
+                                          "fleet"),
                    default="resilience",
                    help="resilience: sentinel/corruption/restart chain; "
                         "shrink: kill workers, re-plan, reshard, continue; "
                         "serve: engine supervisor replay + hot weight "
-                        "swap + SLO admission under injected serve faults")
+                        "swap + SLO admission under injected serve faults; "
+                        "fleet: multi-replica failover, straggler "
+                        "degradation, router flake, priority preemption "
+                        "with KV spill/resume")
     args = p.parse_args()
 
     if args.scenario == "shrink":
@@ -62,6 +76,14 @@ def main() -> int:
             run_shrink_drill
 
         record = run_shrink_drill(seed=args.seed)
+        print(json.dumps(record))
+        return 0 if record["drill_passed"] else 1
+
+    if args.scenario == "fleet":
+        from distributed_deep_learning_tpu.utils.chaos import \
+            run_fleet_resilience_drill
+
+        record = run_fleet_resilience_drill(seed=args.seed)
         print(json.dumps(record))
         return 0 if record["drill_passed"] else 1
 

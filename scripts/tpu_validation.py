@@ -472,6 +472,41 @@ def serving_quant(n_requests=48, max_slots=16):
             "kernel": kernel, **rec}
 
 
+def serving_fleet(n_requests=64, replicas=3):
+    """Fleet serving at a TPU-shaped geometry (ISSUE 15): N paged
+    replicas behind the health-checked prefix-affinity router on one
+    shared-prefix Poisson trace with priority classes.  On TPU the
+    harvest is throughput and routing quality at real decode speeds —
+    predicted prefix-hit tokens, per-priority SLO attainment and the
+    per-replica compile counts (decode_compiles staying 1 per replica
+    is the compile-once discipline surviving the router)."""
+    import jax
+
+    from distributed_deep_learning_tpu.serve.bench import (
+        fleet_serving_bench)
+
+    on_tpu = jax.default_backend() == "tpu"
+    model_kw = (dict(vocab_size=32768, num_layers=12, d_model=768,
+                     num_heads=12, mlp_dim=3072, max_len=1024)
+                if on_tpu else
+                dict(vocab_size=512, num_layers=2, d_model=128,
+                     num_heads=4, mlp_dim=256, max_len=192))
+    load_kw = (dict(n_requests=n_requests, arrival="poisson", rate=4.0,
+                    prompt_short=(16, 64), prompt_long=(128, 256),
+                    long_frac=0.3, shared_prefix_len=128, shared_frac=0.6,
+                    new_tokens=(16, 128), slo_ttft_ms=500.0,
+                    slo_e2e_ms=5000.0)
+               if on_tpu else
+               dict(n_requests=12, prompt_long=(16, 32),
+                    shared_prefix_len=16, new_tokens=(4, 16)))
+    rec = fleet_serving_bench(
+        replicas=replicas, load_kw=load_kw, model_kw=model_kw,
+        max_slots=16 if on_tpu else 4,
+        kv_block_size=32 if on_tpu else 16,
+        prefill_chunk=128 if on_tpu else 32)
+    return {"section": "serving_fleet", "on_tpu": on_tpu, **rec}
+
+
 def autotune(workload="gpt"):
     """Auto-parallelism planner on real hardware: search the plan lattice
     for a TPU-shaped LM geometry (small-GPT on TPU, toy on CPU smoke) and
@@ -623,7 +658,8 @@ def _record_flash_gate(result: dict) -> None:
 
 SECTIONS = ("flash_block_sweep", "flash_vs_dense", "gqa_speedup",
             "s2d_vs_plain", "batch_sweep", "lm_tokens", "serving",
-            "serving_paged", "serving_quant", "autotune", "reshard",
+            "serving_paged", "serving_quant", "serving_fleet",
+            "autotune", "reshard",
             "observability", "collectives", "mfu_diag", "lm_sweep")
 
 
