@@ -266,8 +266,13 @@ def wire_bytes(op: str, method: str, shape, axis_size: int,
     """Bytes one shard SENDS for one collective.  ``shape`` is the local
     block for ``all_gather`` and the full input for ``reduce_scatter``;
     ring and bidirectional XLA schedules both move (S-1)/S of the data
-    per shard."""
+    per shard.  ``kv_migrate`` is point-to-point (the serve tier's
+    KV-block migration): one sender, one receiver, the payload crosses
+    the fabric exactly once — no (S-1)/S schedule factor."""
     elems = int(math.prod(shape)) if shape else 1
+    if op == "kv_migrate":
+        size = WIRE_ITEMSIZE.get(method, itemsize)
+        return elems * size + (_SCALE_BYTES if method == "int8" else 0)
     if op == "reduce_scatter":
         elems //= max(1, axis_size)
     sent = elems * (axis_size - 1)

@@ -48,6 +48,33 @@ class RedistributeStats:
         return d
 
 
+def chunked_device_put(leaf, device, chunk_bytes: int = CHUNK_THRESHOLD_BYTES):
+    """Move one dense array to ``device`` in bounded-size pieces.
+
+    The single-device analogue of :func:`_chunked`: a leaf bigger than
+    ``chunk_bytes`` is sliced along axis 0 so no transfer exceeds the
+    budget (the per-shard placement discipline of arxiv 2112.01075,
+    applied to a point-to-point hop instead of a resharding), then
+    reassembled ON the target device — the source never materialises a
+    second full copy.  Small leaves take one ``device_put``.  Used by
+    both resharding and the serve tier's KV-block migration
+    (``serve/migrate.py``)."""
+    import jax
+
+    nbytes = int(getattr(leaf, "nbytes", 0) or 0)
+    shape = getattr(leaf, "shape", ())
+    if nbytes <= chunk_bytes or not shape or shape[0] <= 1:
+        return jax.device_put(leaf, device)
+    rows = max(1, int(shape[0] * chunk_bytes // nbytes))
+    pieces = [jax.device_put(leaf[i:i + rows], device)
+              for i in range(0, shape[0], rows)]
+    if len(pieces) == 1:
+        return pieces[0]
+    import jax.numpy as jnp
+
+    return jnp.concatenate(pieces, axis=0)
+
+
 def _is_prng_key(leaf) -> bool:
     import jax
 

@@ -563,3 +563,142 @@ def fleet_serving_bench(*, seed: int = 0, replicas: int = 3,
             p: s["slo_attainment"]
             for p, s in st["slo"].get("by_priority", {}).items()},
     }
+
+
+def disagg_serving_bench(*, seed: int = 0,
+                         load_kw: Optional[dict] = None,
+                         model_kw: Optional[dict] = None,
+                         prefill_workers: int = 1,
+                         decode_workers: int = 1,
+                         prefill_streams: int = 4,
+                         max_slots: int = 8,
+                         kv_block_size: int = 16,
+                         prefill_chunk: int = 32,
+                         kv_dtype: Optional[str] = None,
+                         decode_passes: int = 2,
+                         telemetry=None) -> dict:
+    """The disaggregation A/B: the SAME shared-prefix Poisson trace
+    through the unified :class:`PagedEngine` and through
+    :class:`..serve.disagg.DisaggEngine` (prefill pool + decode pool on
+    separate devices, joined by device-to-device KV-block migration).
+
+    Both engines are WARMED on the trace and reset before the timed
+    runs, so the A/B measures steady-state serving, not compiles (the
+    compile counters still ride along and must read compile-once); the
+    migrator's stats are re-zeroed after the warm run so the embedded
+    migration record covers exactly the timed run.  The record carries
+    the baseline-tracked numbers — ``speedup`` (disagg / unified
+    tokens/sec), both ITL p99s, sync-measured ``migration_gbps`` — plus
+    ``token_agreement`` (greedy outputs must be bit-identical: decode
+    workers run the unified engine's own compiled program) and
+    ``prefill_util`` (fraction of batched-chunk rows doing real work).
+
+    Needs >= 2 visible devices; callers on a single-device host re-exec
+    under ``--xla_force_host_platform_device_count`` (bench.py does).
+    """
+    from distributed_deep_learning_tpu.serve import migrate as migrate_mod
+    from distributed_deep_learning_tpu.serve.disagg import DisaggEngine
+
+    model, params = build_model(seed, **(model_kw or {}))
+    spec = LoadSpec(**{**DEFAULT_LOAD, **(load_kw or {})})
+    cap = paged_max_len(model.max_len, kv_block_size, False, 0)
+    trace = make_load(spec, vocab_size=model.vocab_size, seed=seed)
+
+    uni = PagedEngine(model, params, max_slots=max_slots, max_len=cap,
+                      kv_block_size=kv_block_size,
+                      prefill_chunk=min(prefill_chunk, cap),
+                      kv_dtype=kv_dtype)
+    dis = DisaggEngine(model, params, prefill_workers=prefill_workers,
+                       decode_workers=decode_workers,
+                       prefill_streams=prefill_streams,
+                       max_slots=max_slots, max_len=cap,
+                       kv_block_size=kv_block_size,
+                       prefill_chunk=min(prefill_chunk, cap),
+                       kv_dtype=kv_dtype, decode_passes=decode_passes,
+                       telemetry=telemetry)
+
+    # warm both arms (all compiles land here), then reset to a fresh
+    # serving state; the timed runs below retrace NOTHING
+    uni.run(list(trace))
+    uni.reset()
+    dis.run(list(trace))
+    dis.reset()
+    dis.migrator.stats = migrate_mod.MigrationStats()
+
+    du = uni.run(list(trace))
+    dd = dis.run(list(trace), telemetry=telemetry)
+    us, ds = du["stats"], dd["stats"]
+
+    # sync-measured migration bandwidth: move one slot's worth of
+    # committed blocks prefill->decode a few times with a blocking wait,
+    # so seconds are transfer time rather than dispatch time (the run
+    # above overlaps migration with the next prefill chunk by design)
+    pw, dw = dis.prefill[0], dis.decode[0]
+    nb = pw.eng.blocks_per_slot
+    ids = np.arange(nb)
+    dis.migrator.stats = migrate_mod.MigrationStats()
+    for _ in range(4):
+        dw.eng.pools = dis.migrator.migrate(
+            pw.eng.pools, dw.eng.pools, ids, ids, device=dw.device,
+            sync=True, trace_id="bench")
+    sync_stats = dis.migrator.stats
+    at_rest_per_block = sync_stats.wire_bytes / sync_stats.blocks
+
+    # the int8 wire's shrink on the same payload (skipped over int8
+    # pools, where the at-rest wire already moves int8+scales)
+    wire_shrink = None
+    if kv_dtype != "int8":
+        m8 = migrate_mod.BlockMigrator(nb, wire="int8")
+        dw.eng.pools = m8.migrate(pw.eng.pools, dw.eng.pools, ids, ids,
+                                  device=dw.device, trace_id="bench")
+        wire_shrink = round(
+            at_rest_per_block / (m8.stats.wire_bytes / m8.stats.blocks), 3)
+
+    speedup = (round(ds["tokens_per_sec"] / us["tokens_per_sec"], 3)
+               if us["tokens_per_sec"] else None)
+    ul, dl = us["latency"], ds["latency"]
+    return {
+        "metric": "disaggregated prefill/decode vs unified paged engine",
+        "model": {**DEFAULT_MODEL, **(model_kw or {})},
+        "load": {**DEFAULT_LOAD, **(load_kw or {})},
+        "prefill_workers": prefill_workers,
+        "decode_workers": decode_workers,
+        "prefill_streams": prefill_streams,
+        "max_slots": max_slots,
+        "kv_block_size": kv_block_size,
+        "kv_dtype": kv_dtype,
+        "decode_passes": decode_passes,
+        "errors": len(du["errors"]) + len(dd["errors"]),
+        "unified": {
+            "tokens_per_sec": round(us["tokens_per_sec"], 2),
+            "kv_cache_bytes": us["kv_cache_bytes"],
+            "decode_compiles": us["decode_compiles"],
+            "chunk_compiles": us["chunk_compiles"],
+            "itl_p99_s": ul["itl_p99_s"],
+            "ttft_p99_s": ul["ttft_p99_s"],
+        },
+        "disagg": {
+            "tokens_per_sec": round(ds["tokens_per_sec"], 2),
+            "kv_cache_bytes": ds["kv_cache_bytes"],
+            "decode_compiles": ds["decode_compiles"],
+            "chunk_compiles": ds["chunk_compiles"],
+            "migrate_gather_compiles": ds["migrate_gather_compiles"],
+            "migrate_scatter_compiles": ds["migrate_scatter_compiles"],
+            "prefill_util": ds["prefill_util"],
+            "prefill_chunk_calls": ds["prefill_chunk_calls"],
+            "itl_p99_s": dl["itl_p99_s"],
+            "ttft_p99_s": dl["ttft_p99_s"],
+            "migration": ds["migration"],
+        },
+        "speedup": speedup,
+        # > 1 means disagg's inter-token gaps are WORSE than unified's
+        "itl_p99_ratio": (round(dl["itl_p99_s"] / ul["itl_p99_s"], 3)
+                          if ul["itl_p99_s"] else None),
+        "token_agreement": round(
+            _token_agreement(du["results"], dd["results"]), 4),
+        "migration_gbps": round(sync_stats.gb_per_s(), 3),
+        "migration_ms_per_move": round(
+            1e3 * sync_stats.seconds / sync_stats.moves, 3),
+        "wire_bytes_per_block": int(at_rest_per_block),
+        "int8_wire_shrink_x": wire_shrink,
+    }

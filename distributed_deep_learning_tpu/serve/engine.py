@@ -634,8 +634,12 @@ class _SpillRecord:
     stream: list
     committed: int
     pendtok: int
-    kv: object                       # host pytree, pools' treedef
+    kv: object                       # parked pytree, pools' treedef
+                                     # (host arrays, or device arrays on
+                                     # the spill device under
+                                     # migrate="device")
     seq: int                         # spill order, FIFO tiebreak
+    digest: Optional[bytes] = None   # end-to-end integrity (device path)
 
 
 class PagedEngine:
@@ -682,7 +686,8 @@ class PagedEngine:
                  kv_dtype: Optional[str] = None,
                  weight_dtype: Optional[str] = None,
                  preempt: bool = False,
-                 spill_dir: Optional[str] = None):
+                 spill_dir: Optional[str] = None,
+                 migrate: str = "host"):
         validate_sampling(top_k, top_p)
         quant.check_dtype("kv_dtype", kv_dtype)
         quant.check_dtype("weight_dtype", weight_dtype)
@@ -762,6 +767,31 @@ class PagedEngine:
         self.spill_dir = spill_dir
         if spill_dir is not None:
             os.makedirs(spill_dir, exist_ok=True)
+        # preemption spill transport: "host" round-trips the slot image
+        # through host numpy (always available); "device" parks it on
+        # another local device via the chunked migration schedule — no
+        # host copy on the hot path, digest-audited end to end.  The
+        # npz audit (spill_dir) is written either way.
+        if migrate not in ("host", "device"):
+            raise ValueError(f"migrate must be 'host' or 'device', got "
+                             f"{migrate!r}")
+        if migrate == "device" and len(jax.local_devices()) < 2:
+            raise ValueError(
+                "migrate='device' needs a second local device to park "
+                "spilled KV on; only 1 is visible (use migrate='host', "
+                "or run under a multi-device mesh)")
+        self.migrate_kind = migrate
+        self._home_device = jax.local_devices()[0]
+        self._spill_device = (jax.local_devices()[-1]
+                              if migrate == "device" else None)
+        #: fault-injection seam: callable payload -> payload applied to
+        #: the spilled KV before the device hop (the ``migrate_drop``
+        #: chaos kind); the resume-side digest check turns any
+        #: corruption into a MigrationError the supervisor replays.
+        self._migrate_chaos = None
+        self._spill_moves = 0
+        self._spill_move_bytes = 0
+        self._spill_move_seconds = 0.0
         # spill gathers a whole slot WITHOUT donating the pools (they
         # must survive the read); unspill donates them like every other
         # pool-updating program
@@ -1372,16 +1402,34 @@ class PagedEngine:
                 cands,
                 key=lambda i: (-sched.slots[i].request.priority,
                                len(sched.slots[i].generated), i))[0]
+            t0_sp = time.perf_counter()
             kv_dev = self._spill(self.pools,
                                  jnp.asarray(mgr.tables[victim]))
-            kv = jax.tree.map(np.asarray, kv_dev)  # host copy = barrier
+            if self.migrate_kind == "device":
+                # device-to-device handoff: digest the at-rest image,
+                # then park it on the spill device via the chunked
+                # migration schedule — no host copy, no barrier beyond
+                # the digest read (which doubles as the audit)
+                from distributed_deep_learning_tpu.serve import \
+                    migrate as migrate_mod
+                digest = migrate_mod.tree_digest(kv_dev)
+                payload = kv_dev
+                if self._migrate_chaos is not None:
+                    payload = self._migrate_chaos(payload)
+                kv = migrate_mod.offload(payload, self._spill_device)
+                self._spill_moves += 1
+                self._spill_move_bytes += migrate_mod.tree_bytes(kv_dev)
+                self._spill_move_seconds += time.perf_counter() - t0_sp
+            else:
+                kv = jax.tree.map(np.asarray, kv_dev)  # host copy=barrier
+                digest = None
             req, gen = sched.preempt(victim)
             mgr.release(victim)
             rec = _SpillRecord(request=req, generated=gen,
                                stream=stream.pop(victim),
                                committed=committed.pop(victim),
                                pendtok=pendtok.pop(victim),
-                               kv=kv, seq=spill_seq)
+                               kv=kv, seq=spill_seq, digest=digest)
             plans.pop(victim, None)
             spill_seq += 1
             spilled.append(rec)
@@ -1423,8 +1471,36 @@ class PagedEngine:
                               mgr.tables[idx][pidx // bs],
                               paged.TRASH).astype(np.int32)
             offsets = (pidx % bs).astype(np.int32)
+            if self.migrate_kind == "device":
+                # hop the parked image back, then verify the round trip
+                # end to end: a transfer lost or corrupted in EITHER
+                # direction surfaces here, before anything is scattered
+                # into the live pools
+                from distributed_deep_learning_tpu.serve import \
+                    migrate as migrate_mod
+                t0_rs = time.perf_counter()
+                kv_in = migrate_mod.offload(rec.kv, self._home_device)
+                if rec.digest is not None and \
+                        migrate_mod.tree_digest(kv_in) != rec.digest:
+                    raise migrate_mod.MigrationError(
+                        f"device spill/resume of request "
+                        f"{rec.request.uid} failed its digest check — "
+                        f"KV transfer lost or corrupted; replay from "
+                        f"the ledger")
+                self._spill_moves += 1
+                self._spill_move_bytes += migrate_mod.tree_bytes(kv_in)
+                self._spill_move_seconds += time.perf_counter() - t0_rs
+                # the hop commits kv_in to the home device, but pools
+                # born under a training mesh can live replicated across
+                # it — match their placement or the scatter jit rejects
+                # the mixed commitment
+                kv_in = jax.device_put(
+                    kv_in,
+                    jax.tree.map(lambda l: l.sharding, self.pools))
+            else:
+                kv_in = jax.tree.map(jnp.asarray, rec.kv)
             self.pools = self._unspill(
-                self.pools, jax.tree.map(jnp.asarray, rec.kv),
+                self.pools, kv_in,
                 jnp.asarray(blocks), jnp.asarray(offsets))
             stream[idx] = rec.stream
             committed[idx] = rec.committed
@@ -1784,6 +1860,11 @@ class PagedEngine:
                 "still_spilled": len(spilled),
                 "spill_compiles": self._spill.traces,
                 "unspill_compiles": self._unspill.traces,
+                "spill_path": self.migrate_kind,
+                # engine-lifetime device-hop accounting (0 under "host")
+                "migration_moves": self._spill_moves,
+                "migration_bytes": self._spill_move_bytes,
+                "migration_seconds": round(self._spill_move_seconds, 6),
             },
             "slo": slo_report(accepted, ttft_s, e2e_s),
             "latency": latency,

@@ -50,6 +50,7 @@ from typing import Iterable, Optional
 import numpy as np
 
 from distributed_deep_learning_tpu.obs.metrics import MetricsRegistry
+from distributed_deep_learning_tpu.serve import migrate as migrate_mod
 from distributed_deep_learning_tpu.serve import paged
 from distributed_deep_learning_tpu.serve.load import merge_slo_reports
 from distributed_deep_learning_tpu.serve.scheduler import Request
@@ -127,7 +128,8 @@ class FleetRouter:
                  retries: int = 2, max_restarts: int = 8,
                  stall_timeout_s=None, slow_tick_s: Optional[float] = None,
                  degrade_after: int = 2, degrade_pressure: float = 0.67,
-                 admissions: Optional[dict] = None, telemetry=None,
+                 admissions: Optional[dict] = None,
+                 share_prefixes: bool = False, telemetry=None,
                  recorder=None, clock=time.monotonic):
         engines = list(engines)
         if not engines:
@@ -160,8 +162,16 @@ class FleetRouter:
         self.route_seq = 0
         self.flake_degraded = 0
         self.predicted_hit_tokens = 0
+        self.shared_prefix_moves = 0
+        self.shared_prefix_tokens = 0
         reg = telemetry.registry if telemetry is not None \
             else MetricsRegistry()
+        # warm prefix sharing: when placement lands off the warm
+        # replica (health outranks hits), migrate the donor's committed
+        # prefix blocks to the chosen one instead of recomputing them
+        self._migrator = migrate_mod.BlockMigrator(
+            engines[0].blocks_per_slot, registry=reg) \
+            if share_prefixes else None
         self._g_health = {r.rid: reg.gauge("fleet_replica_health",
                                            replica=str(r.rid))
                           for r in self.replicas}
@@ -223,6 +233,23 @@ class FleetRouter:
                              -hits[rep.rid], len(rep.assigned),
                              rep.rid))[0]
         self.predicted_hit_tokens += hits[best.rid]
+        if self._migrator is not None and not flaky:
+            donor = max((r for r in candidates if r.rid != best.rid),
+                        key=lambda r: hits[r.rid], default=None)
+            if donor is not None and hits[donor.rid] > hits[best.rid]:
+                # best-effort: moves only blocks the donor's REAL index
+                # holds and the destination can adopt; 0 is fine
+                moved = migrate_mod.clone_prefix(
+                    donor.engine, best.engine, req.prompt,
+                    self._migrator)
+                if moved:
+                    self.shared_prefix_moves += 1
+                    self.shared_prefix_tokens += moved
+                    if self.recorder is not None:
+                        self.recorder.record(
+                            "prefix_share", uid=req.uid,
+                            donor=donor.rid, replica=best.rid,
+                            tokens=moved)
         best.assigned.append(req)
         best.placements += 1
         # feed the placement back: the routed prompt's blocks will be
@@ -393,6 +420,8 @@ class FleetRouter:
                                 for r in self.replicas},
                 "predicted_hit_tokens": self.predicted_hit_tokens,
                 "flake_degraded": self.flake_degraded,
+                "shared_prefix_moves": self.shared_prefix_moves,
+                "shared_prefix_tokens": self.shared_prefix_tokens,
             },
             "per_replica": {
                 r.rid: {

@@ -514,6 +514,60 @@ class BlockManager:
         self._chain[slot] = (done, h)
         return added
 
+    def adopt_prefix(self, tokens, n_blocks: int):
+        """Register the first ``n_blocks`` full blocks of ``tokens`` as
+        if a local slot had prefilled them, allocating fresh physical
+        blocks for the chain links not already indexed — the
+        destination half of cross-engine prefix cloning
+        (:func:`..serve.migrate.clone_prefix`).
+
+        Returns ``(start, new_block_ids)``: ``start`` chain links were
+        already indexed here (nothing to copy), and ``new_block_ids``
+        are freshly-allocated blocks for links ``start..`` — held ONLY
+        by the index (refcount 1), so they age out under LRU eviction
+        like any locally-prefilled prefix.  The caller MUST fill every
+        returned block with the exact at-rest KV for its positions
+        before anything admits against the chain.  Returns None when
+        the pool cannot free enough blocks (sharing is best-effort and
+        never steals from live slots)."""
+        bs = self.block_size
+        toks = np.asarray(tokens)
+        chain = []
+        h = b""
+        for i in range(int(n_blocks)):
+            blk = tuple(int(t) for t in toks[i * bs:(i + 1) * bs])
+            if len(blk) < bs:
+                break
+            parent = h
+            h = chain_hash(h, blk)
+            chain.append((parent, h, blk))
+        start = 0
+        for parent, h2, blk in chain:
+            e = self.index.get(h2)
+            if e is None:
+                break
+            if e.tokens != blk:     # hash collision: never adopt over it
+                return None
+            self.index.touch(h2)    # protect the stem from our own evict
+            start += 1
+        todo = chain[start:]
+        if not todo:
+            return start, []
+        if len(self.free) < len(todo):
+            self.evict(len(todo))
+        if len(self.free) < len(todo):
+            return None
+        ids = []
+        for parent, h2, blk in todo:
+            b = self._alloc()       # refcount 1: the index's reference
+            if not self.index.add(parent, h2, b, blk):
+                self._deref(b)
+                return None
+            ids.append(b)
+        if self.on_event is not None:
+            self.on_event("adopt", blocks=len(ids))
+        return start, ids
+
     def prefix_summary(self) -> frozenset:
         """Cheap export of this manager's prefix-index coverage: the set
         of chain hashes currently indexed.  Each hash commits to an

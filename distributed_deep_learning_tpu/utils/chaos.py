@@ -50,8 +50,13 @@ SERVE_KINDS = ("nan_logits", "stalled_tick", "corrupt_block",
 #: watchdog (``target`` selects the replica id); ``router_flake``
 #: degrades the router's placement signal through
 #: :meth:`ChaosPlan.route_hook` (``step`` means routing SEQUENCE number
-#: there, ``magnitude`` the window width in placements)
-FLEET_KINDS = ("replica_crash", "replica_straggler", "router_flake")
+#: there, ``magnitude`` the window width in placements);
+#: ``migrate_drop`` corrupts one device-to-device KV transfer through
+#: :meth:`ChaosPlan.migrate_corruptor` (``step`` means MIGRATION number
+#: — the n-th payload is damaged in flight, tripping the end-to-end
+#: digest and forcing a ledger replay)
+FLEET_KINDS = ("replica_crash", "replica_straggler", "router_flake",
+               "migrate_drop")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -313,6 +318,53 @@ class ChaosPlan:
                                              fault=ev.kind)
                 flaky = True
         return flaky
+
+    def migrate_corruptor(self):
+        """Payload->payload corruptor for ``migrate_drop`` events.
+
+        Install on a :class:`..serve.engine.PagedEngine`'s
+        ``_migrate_chaos`` seam (device-path preemption spill) or pass
+        as :meth:`..serve.migrate.BlockMigrator.migrate`'s ``chaos=``.
+        Counts the transfers flowing through it; when transfer number
+        ``event.step`` passes, its largest leaf is bit-damaged IN
+        FLIGHT — after the sender's digest, before the receiver's
+        recheck — modelling a lost/corrupt fabric transfer.  The digest
+        recheck then raises ``MigrationError`` and the supervisor's
+        ledger replay recovers bit-identically.  One-shot per event."""
+        calls = {"n": 0}
+
+        def corrupt(payload):
+            import jax.numpy as jnp
+
+            calls["n"] += 1
+            for i, ev in enumerate(self.events):
+                if (i in self._done or ev.kind != "migrate_drop"
+                        or ev.step > calls["n"]):
+                    continue
+                self._done.add(i)
+                self.fired.append((calls["n"], ev.kind))
+                if self.recorder is not None:
+                    self.recorder.record("chaos_fired", step=calls["n"],
+                                         fault=ev.kind)
+                import jax
+
+                leaves, treedef = jax.tree_util.tree_flatten(payload)
+                k = max(range(len(leaves)),
+                        key=lambda j: getattr(leaves[j], "size", 0))
+                leaf = leaves[k]
+                flat = jnp.ravel(leaf)
+                if jnp.issubdtype(leaf.dtype, jnp.floating):
+                    bad = flat.at[0].set(flat[0] + jnp.asarray(
+                        1.0, leaf.dtype))
+                elif leaf.dtype == jnp.bool_:
+                    bad = flat.at[0].set(~flat[0])
+                else:
+                    bad = flat.at[0].set(flat[0] ^ 1)
+                leaves[k] = bad.reshape(leaf.shape)
+                payload = jax.tree_util.tree_unflatten(treedef, leaves)
+            return payload
+
+        return corrupt
 
     # -- out-of-band injectors ---------------------------------------------
     @staticmethod
@@ -1059,6 +1111,51 @@ def run_fleet_resilience_drill(seed: int = 0) -> dict:
         "passed": ok,
     }
     all_ok = all_ok and ok
+
+    # --- 6. migrate_drop: corrupted device KV transfer -> digest trips,
+    # ledger replay recovers bit-identically ------------------------------
+    import jax
+
+    if len(jax.local_devices()) >= 2:
+        from distributed_deep_learning_tpu.serve.supervisor import \
+            ServeSupervisor
+
+        plan = ChaosPlan([ChaosEvent(step=1, kind="migrate_drop")],
+                         seed=seed)
+        meng = PagedEngine(model, params, max_slots=2, max_len=48,
+                           kv_block_size=8, prefill_chunk=8,
+                           preempt=True, migrate="device")
+        meng._migrate_chaos = plan.migrate_corruptor()
+        sup = ServeSupervisor(meng, retries=2)
+        mout = sup.run(list(preqs))
+        ms = mout["stats"]
+        m_identical = all(
+            mout["results"].get(u) is not None
+            and np.array_equal(mout["results"][u], pref[u]) for u in pref)
+        fault_kinds = [f.get("kind") for f in ms["faults"]]
+        ok = (m_identical and bool(plan.fired)
+              and ms["requests_lost"] == 0 and not mout["errors"]
+              and "MigrationError" in fault_kinds
+              and meng._decode.traces == 1)
+        record["scenarios"]["migrate_drop"] = {
+            "fired": list(plan.fired),
+            "faults": fault_kinds,
+            "restarts": ms["restarts"],
+            "requests_lost": ms["requests_lost"],
+            "spill_path": ms["engine"]["preempt"]["spill_path"],
+            "migration_moves": ms["engine"]["preempt"]["migration_moves"],
+            "bit_identical": m_identical,
+            "decode_compiles": meng._decode.traces,
+            "passed": ok,
+        }
+        all_ok = all_ok and ok
+        lost_total += ms["requests_lost"]
+    else:
+        record["scenarios"]["migrate_drop"] = {
+            "skipped": "needs >= 2 local devices for the device-path "
+                       "spill (run under a forced multi-device host)",
+            "passed": True,
+        }
 
     record["detection_ticks_max"] = max(detect) if detect else None
     record["recovery_seconds_max"] = (round(max(recover), 3)

@@ -219,6 +219,16 @@ class Config:
     spill_dir: str | None = None        # fleet serving: host directory for
                                         #   preempted-slot KV spill files
                                         #   (engine preemption audit trail)
+    disagg: bool = False                # serving: disaggregate the replica
+                                        #   into prefill + decode device
+                                        #   pools joined by KV-block
+                                        #   migration (serve/disagg.py)
+    prefill_workers: int = 1            # serving: devices in the disagg
+                                        #   prefill pool (the rest decode)
+    migrate: str = "host"               # serving: where preempted KV
+                                        #   parks — host (npz-auditable
+                                        #   arrays) or device (device-to-
+                                        #   device, digest-audited)
     publish_weights: str | None = None  # checkpointing: atomically publish
                                         #   verified saves for serving hot
                                         #   reload (serve/reload.py)
@@ -551,6 +561,26 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
                         "slot's spilled KV to DIR as an npz audit "
                         "trail (resume itself stays in host memory); "
                         "requires --priority-classes")
+    p.add_argument("--disagg", action="store_true",
+                   help="serving: disaggregate the replica into a "
+                        "prefill worker pool (chunked, compile-once per "
+                        "chunk width) and decode workers on separate "
+                        "devices, joined by device-to-device KV-block "
+                        "migration (serve/disagg.py); requires --paged "
+                        "and at least 2 local devices")
+    p.add_argument("--prefill-workers", dest="prefill_workers", type=int,
+                   default=1, metavar="N",
+                   help="disaggregated serving: devices in the prefill "
+                        "pool; the remaining visible devices become "
+                        "decode workers, so N must leave at least one "
+                        "(requires --disagg)")
+    p.add_argument("--migrate", choices=["host", "device"],
+                   default="host",
+                   help="serving preemption: where a preempted slot's "
+                        "KV parks — host (npz-auditable arrays, the "
+                        "default) or device (chunked device-to-device "
+                        "block migration with end-to-end digest audit; "
+                        "needs a second local device)")
     p.add_argument("--publish-weights", dest="publish_weights", type=str,
                    default=None, metavar="DIR",
                    help="checkpointing: after each verified save, "
@@ -960,6 +990,44 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         raise SystemExit("--spill-dir requires --priority-classes "
                          "(spill files are only written when "
                          "preemption can fire)")
+    if args.disagg and not args.paged:
+        raise SystemExit("--disagg requires --paged (the prefill and "
+                         "decode pools exchange committed paged-KV "
+                         "blocks; the dense slot cache has no block "
+                         "table to migrate)")
+    if args.prefill_workers < 1:
+        raise SystemExit(f"--prefill-workers {args.prefill_workers}: "
+                         "must be >= 1")
+    if args.prefill_workers != 1 and not args.disagg:
+        raise SystemExit("--prefill-workers requires --disagg (worker "
+                         "pools only exist in disaggregated serving)")
+    if args.disagg or args.migrate == "device":
+        # these paths hard-require a device split, so resolve the
+        # visible topology now and fail with the flag name instead of
+        # deep inside engine construction (jax is imported lazily:
+        # plain parses must not initialize a backend)
+        import jax
+
+        ndev = len(jax.local_devices())
+        if args.migrate == "device" and ndev < 2:
+            raise SystemExit("--migrate device: needs a second local "
+                             f"device to park spilled KV on; only "
+                             f"{ndev} visible — use --migrate host, or "
+                             "run under a multi-device mesh (e.g. "
+                             "XLA_FLAGS=--xla_force_host_platform_"
+                             "device_count=2)")
+        if args.disagg and ndev < 2:
+            raise SystemExit("--disagg: disaggregated serving needs "
+                             ">= 2 local devices (one per pool); only "
+                             f"{ndev} visible — drop --disagg for the "
+                             "unified paged engine, or run under a "
+                             "multi-device mesh")
+        if args.disagg and args.prefill_workers >= ndev:
+            raise SystemExit(f"--prefill-workers {args.prefill_workers}"
+                             f": the {ndev} visible devices must "
+                             "partition into prefill + decode pools "
+                             "with at least one decode worker — use "
+                             f"1..{ndev - 1}")
     if args.publish_weights and not args.checkpoint_dir:
         raise SystemExit("--publish-weights requires --checkpoint-dir "
                          "(only verified checkpoint saves are "
@@ -1027,6 +1095,9 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         replicas=args.replicas,
         priority_classes=parse_priority_classes(args.priority_classes),
         spill_dir=args.spill_dir,
+        disagg=args.disagg,
+        prefill_workers=args.prefill_workers,
+        migrate=args.migrate,
         publish_weights=args.publish_weights,
         pos_embedding=args.pos_embedding,
         num_kv_heads=args.num_kv_heads,

@@ -672,7 +672,11 @@ def _gpt_serve_paged(config: Config, model, params, logger, dataset,
         fr = np.asarray([f for _, f in pcs]) / sum(f for _, f in pcs)
         trace = [dataclasses.replace(r, priority=int(
             rng.choice([p for p, _ in pcs], p=fr))) for r in trace]
-        engine_kw.update(preempt=True, spill_dir=config.spill_dir)
+        engine_kw.update(preempt=True, spill_dir=config.spill_dir,
+                         migrate=config.migrate)
+    if config.disagg:
+        _gpt_serve_disagg(config, model, params, logger, trace, engine_kw)
+        return
     if config.replicas > 1:
         _gpt_serve_fleet(config, model, params, logger, trace, engine_kw)
         return
@@ -738,6 +742,43 @@ def _gpt_serve_fleet(config: Config, model, params, logger, trace,
                 f"p{p}={s['slo_attainment']:.2f}" for p, s in
                 sorted(bp.items()) if s["slo_attainment"] is not None) + ")"
     logger.info(line)
+
+
+def _gpt_serve_disagg(config: Config, model, params, logger, trace,
+                      engine_kw: dict) -> None:
+    """``--serve --paged --disagg``: the same trace through the
+    disaggregated engine (serve/disagg.py) — prefill worker pool +
+    decode worker pool on disjoint devices, per-prompt KV-block
+    migration handoff, greedy outputs bit-identical to the unified
+    engine."""
+    import jax
+
+    from distributed_deep_learning_tpu.serve.disagg import DisaggEngine
+
+    if config.draft:
+        logger.info("serve(disagg): --draft ignored (speculation runs "
+                    "on the unified engine only)")
+    ndev = len(jax.local_devices())
+    eng = DisaggEngine(
+        model, params,
+        prefill_workers=config.prefill_workers,
+        decode_workers=max(1, ndev - config.prefill_workers),
+        max_slots=engine_kw["max_slots"], max_len=engine_kw["max_len"],
+        kv_block_size=engine_kw["kv_block_size"],
+        prefill_chunk=engine_kw["prefill_chunk"],
+        kv_dtype=engine_kw["kv_dtype"],
+        weight_dtype=engine_kw["weight_dtype"])
+    out = eng.run(list(trace))
+    s = out["stats"]
+    mig = s["migration"]
+    logger.info(
+        f"serve(disagg): {s['requests']} requests, "
+        f"{s['generated_tokens']} tokens at "
+        f"{s['tokens_per_sec']:.1f} tok/s over "
+        f"{config.prefill_workers}P+{max(1, ndev - config.prefill_workers)}D, "
+        f"prefill util {s['prefill_util']:.2f}, migrated "
+        f"{mig['moves']} handoffs ({mig['wire_bytes']} B), compiles "
+        f"chunk={s['chunk_compiles']} decode={s['decode_compiles']}")
 
 
 def _gpt_post(config: Config, state, logger, dataset) -> None:

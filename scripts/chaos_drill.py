@@ -32,8 +32,12 @@ Four scenarios, selected with ``--scenario``:
   quarantined and its in-flight requests replayed bit-identically onto
   the survivors (zero lost), a straggling replica is health-degraded,
   a flaky router loses its placement signal without losing
-  correctness, and priority preemption spills low-priority KV to host
-  and resumes it bit-identically (priority 0 never preempted).
+  correctness, priority preemption spills low-priority KV and resumes
+  it bit-identically (priority 0 never preempted), and a
+  ``migrate_drop`` — a device-to-device KV transfer corrupted in
+  flight — trips the migration payload's end-to-end digest
+  (``MigrationError``) and is recovered bit-identically by the
+  supervisor's ledger replay, zero requests lost.
 
 All are CPU-runnable (the chains are host+XLA logic, not
 accelerator-specific); ``bench.py`` embeds the same records as its
@@ -80,6 +84,14 @@ def main() -> int:
         return 0 if record["drill_passed"] else 1
 
     if args.scenario == "fleet":
+        # the migrate_drop scenario needs a second local device to park
+        # spilled KV on; force a small multi-device CPU host if the
+        # caller hasn't picked a topology (must land before jax imports)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
         from distributed_deep_learning_tpu.utils.chaos import \
             run_fleet_resilience_drill
 

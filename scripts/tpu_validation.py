@@ -507,6 +507,50 @@ def serving_fleet(n_requests=64, replicas=3):
     return {"section": "serving_fleet", "on_tpu": on_tpu, **rec}
 
 
+def serving_disagg(n_requests=48):
+    """Disaggregated prefill/decode serving at a TPU-shaped geometry
+    (ISSUE 16): prefill worker pool + decode worker pool on separate
+    chips, joined by device-to-device KV-block migration, A/B'd against
+    the unified paged engine on the same shared-prefix Poisson trace.
+    On TPU this is the first run where the migration primitive moves
+    blocks over real ICI (the CPU number times emulated-host
+    device_put) and where the prefill pool's batched chunk program runs
+    on silicon the decode pool never shares — the interference-free ITL
+    DistServe buys.  Greedy outputs must stay bit-identical to the
+    unified engine (``token_agreement`` 1.0) and every compile counter
+    must read 1."""
+    # one device per pool: on the CPU smoke box force an emulated pair
+    # before backend init (no-op on TPU — the flag only shapes the
+    # host platform)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+
+    import jax
+
+    from distributed_deep_learning_tpu.serve.bench import (
+        disagg_serving_bench)
+
+    on_tpu = jax.default_backend() == "tpu"
+    model_kw = (dict(vocab_size=32768, num_layers=12, d_model=768,
+                     num_heads=12, mlp_dim=3072, max_len=1024)
+                if on_tpu else None)
+    load_kw = (dict(n_requests=n_requests, arrival="poisson", rate=4.0,
+                    prompt_short=(16, 64), prompt_long=(128, 256),
+                    long_frac=0.3, shared_prefix_len=128, shared_frac=0.6,
+                    new_tokens=(16, 128), slo_ttft_ms=500.0,
+                    slo_e2e_ms=5000.0)
+               if on_tpu else dict(n_requests=12))
+    rec = disagg_serving_bench(
+        seed=17, load_kw=load_kw, model_kw=model_kw,
+        prefill_workers=1, decode_workers=1,
+        prefill_streams=4, max_slots=16 if on_tpu else 8,
+        kv_block_size=32 if on_tpu else 16,
+        prefill_chunk=128 if on_tpu else 32)
+    return {"section": "serving_disagg", "on_tpu": on_tpu, **rec}
+
+
 def autotune(workload="gpt"):
     """Auto-parallelism planner on real hardware: search the plan lattice
     for a TPU-shaped LM geometry (small-GPT on TPU, toy on CPU smoke) and
@@ -659,7 +703,7 @@ def _record_flash_gate(result: dict) -> None:
 SECTIONS = ("flash_block_sweep", "flash_vs_dense", "gqa_speedup",
             "s2d_vs_plain", "batch_sweep", "lm_tokens", "serving",
             "serving_paged", "serving_quant", "serving_fleet",
-            "autotune", "reshard",
+            "serving_disagg", "autotune", "reshard",
             "observability", "collectives", "mfu_diag", "lm_sweep")
 
 

@@ -1,0 +1,427 @@
+"""Disaggregated serving (ISSUE 16): KV-block migration + prefill/decode
+split.
+
+The load-bearing guarantees this PR adds on top of the paged serving
+stack:
+
+* device-to-device block migration is LOSSLESS at rest — fp32, bf16 and
+  int8+scales pools all round-trip bit-exactly through the gather /
+  (chunked device_put) / scatter chain, and a payload corrupted in
+  flight trips the end-to-end digest (``MigrationError``), never a
+  silent wrong answer;
+* the disaggregated engine (prefill worker pool + decode worker pool on
+  separate devices, handoff via migration) produces greedy outputs
+  BIT-IDENTICAL to the unified :class:`PagedEngine` on the same trace —
+  the decode workers literally run the unified engine's own compiled
+  decode program;
+* preemption's ``migrate='device'`` spill path resumes bit-identically
+  to the host-npz path it upgrades (and to the uncontended reference);
+* all of it compile-once: batched prefill, decode, migration gather and
+  scatter each trace exactly once per worker;
+* the new CLI knobs (``--disagg``, ``--prefill-workers``, ``--migrate``)
+  reject bad combinations at parse time with actionable messages.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_deep_learning_tpu.models.transformer import CausalLM
+from distributed_deep_learning_tpu.parallel.collectives import wire_bytes
+from distributed_deep_learning_tpu.serve import migrate as migrate_mod
+from distributed_deep_learning_tpu.serve.disagg import DisaggEngine
+from distributed_deep_learning_tpu.serve.engine import PagedEngine
+from distributed_deep_learning_tpu.serve.migrate import (BlockMigrator,
+                                                         MigrationError,
+                                                         clone_prefix,
+                                                         tree_digest)
+from distributed_deep_learning_tpu.serve.scheduler import Request
+from distributed_deep_learning_tpu.utils.config import parse_args
+
+MODEL = dict(vocab_size=61, num_layers=1, d_model=32, num_heads=4,
+             mlp_dim=64, max_len=48)
+
+
+@functools.lru_cache(maxsize=None)
+def _shared():
+    model = CausalLM(**MODEL)
+    toks = jnp.ones((1, 4), jnp.int32)
+    return model, model.init(jax.random.key(1), toks)["params"]
+
+
+def _req(uid, prompt_len=6, new=8, tick=0, prio=1, seed=None):
+    rng = np.random.default_rng(uid if seed is None else seed)
+    return Request(uid=uid,
+                   prompt=rng.integers(1, MODEL["vocab_size"],
+                                       size=prompt_len).astype(np.int64),
+                   max_new_tokens=new, arrival_tick=tick, priority=prio)
+
+
+def _mixed_trace(n=10, shared_len=9):
+    """Mixed lengths incl. a shared-prefix cluster (the migration and
+    prefix-index paths all get exercised)."""
+    rng = np.random.default_rng(7)
+    shared = rng.integers(1, MODEL["vocab_size"], shared_len)
+    reqs = []
+    for uid in range(n):
+        plen = int(rng.integers(4, 20))
+        prompt = rng.integers(1, MODEL["vocab_size"], plen)
+        if uid % 2:
+            prompt = np.concatenate([shared, prompt])
+        reqs.append(Request(uid=uid, prompt=prompt.astype(np.int64),
+                            max_new_tokens=int(rng.integers(3, 10)),
+                            arrival_tick=uid // 3))
+    return reqs
+
+
+def _engine_with_committed(kv_dtype=None, n=3):
+    """A unified engine that has served a few requests, so its pools
+    hold real committed KV — the migration payload fixture."""
+    model, params = _shared()
+    eng = PagedEngine(model, params, max_slots=2, kv_block_size=8,
+                      prefill_chunk=8, kv_dtype=kv_dtype)
+    eng.run([_req(u, prompt_len=12, new=4) for u in range(n)])
+    return eng
+
+
+# --- migration bit-exactness ------------------------------------------
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "bf16", "int8"])
+def test_migration_round_trip_bit_exact(kv_dtype):
+    eng = _engine_with_committed(kv_dtype)
+    dst = PagedEngine(*_shared(), max_slots=2, kv_block_size=8,
+                      prefill_chunk=8, kv_dtype=kv_dtype)
+    mig = BlockMigrator(eng.blocks_per_slot)
+    ids = np.arange(2)  # two committed blocks
+
+    def rows(pools):  # block-major leaves only (pools also carry 0-dim
+        return [np.asarray(leaf[:2])  # cache-index scalars)
+                for leaf in jax.tree.leaves(pools)
+                if getattr(leaf, "ndim", 0) >= 1]
+
+    before = rows(eng.pools)
+    dst.pools = mig.migrate(eng.pools, dst.pools, ids, ids,
+                            device=jax.local_devices()[1], verify=True)
+    for b, a in zip(before, rows(dst.pools)):
+        assert b.dtype == a.dtype
+        np.testing.assert_array_equal(b, a)
+    assert mig.stats.moves == 1 and mig.stats.hops == 1
+    assert mig.stats.verified == 1 and mig.stats.failed == 0
+
+
+def test_migration_digest_catches_in_flight_corruption():
+    eng = _engine_with_committed()
+    dst = PagedEngine(*_shared(), max_slots=2, kv_block_size=8,
+                      prefill_chunk=8)
+    mig = BlockMigrator(eng.blocks_per_slot)
+
+    def flip(payload):
+        leaves, treedef = jax.tree.flatten(payload)
+        leaves[0] = leaves[0].at[0].add(1.0)
+        return jax.tree.unflatten(treedef, leaves)
+
+    with pytest.raises(MigrationError, match="digest"):
+        mig.migrate(eng.pools, dst.pools, np.arange(2), np.arange(2),
+                    device=jax.local_devices()[1], verify=True,
+                    chaos=flip)
+    assert mig.stats.failed == 1
+
+
+def test_migration_compile_once_across_moves_and_id_sets():
+    eng = _engine_with_committed()
+    dst = PagedEngine(*_shared(), max_slots=2, kv_block_size=8,
+                      prefill_chunk=8)
+    mig = BlockMigrator(eng.blocks_per_slot)
+    for src in ([0, 1], [2, 3], [1, 2]):  # same width, new ids
+        dst.pools = mig.migrate(eng.pools, dst.pools,
+                                np.asarray(src), np.arange(2),
+                                device=jax.local_devices()[1])
+    assert mig.compiles == 2  # one gather trace + one scatter trace
+    assert mig._gather.traces == 1 and mig._scatter.traces == 1
+    assert mig.stats.moves == 3
+
+
+def test_int8_wire_shrinks_bytes_on_fp32_pools():
+    eng = _engine_with_committed()
+    dst = PagedEngine(*_shared(), max_slots=2, kv_block_size=8,
+                      prefill_chunk=8)
+    at_rest = BlockMigrator(eng.blocks_per_slot)
+    dst.pools = at_rest.migrate(eng.pools, dst.pools, np.arange(2),
+                                np.arange(2))
+    i8 = BlockMigrator(eng.blocks_per_slot, wire="int8")
+    dst.pools = i8.migrate(eng.pools, dst.pools, np.arange(2),
+                           np.arange(2))
+    assert i8.stats.wire_bytes < at_rest.stats.wire_bytes / 3
+
+
+def test_kv_migrate_wire_bytes_point_to_point():
+    # one sender, one receiver: no (S-1)/S collective schedule factor
+    assert wire_bytes("kv_migrate", "none", (8, 32), 8) == 8 * 32 * 4
+    assert wire_bytes("kv_migrate", "int8", (8, 32), 8) == 8 * 32 + 4
+    # and bf16 halves the fp32 payload
+    assert wire_bytes("kv_migrate", "bf16", (8, 32), 8) == 8 * 32 * 2
+
+
+def test_tree_digest_sees_every_leaf():
+    eng = _engine_with_committed()
+    d0 = tree_digest(eng.pools)
+    assert d0 == tree_digest(eng.pools)
+    leaves, treedef = jax.tree.flatten(eng.pools)
+    leaves[-1] = leaves[-1].at[0].add(1.0)
+    assert d0 != tree_digest(jax.tree.unflatten(treedef, leaves))
+
+
+# --- warm-prefix sharing across engines (clone_prefix) -----------------
+
+
+def _predicted_hit(eng, prompt):
+    from distributed_deep_learning_tpu.serve import paged
+
+    return paged.predict_shared_len(eng.manager.prefix_summary(),
+                                    prompt, eng.block_size)
+
+
+def test_clone_prefix_moves_shared_blocks_and_target_hits():
+    model, params = _shared()
+    prompt = _req(0, prompt_len=20).prompt
+    donor = PagedEngine(model, params, max_slots=2, kv_block_size=8,
+                        prefill_chunk=8)
+    donor.run([Request(uid=0, prompt=prompt, max_new_tokens=4)])
+    target = PagedEngine(model, params, max_slots=2, kv_block_size=8,
+                         prefill_chunk=8)
+    assert _predicted_hit(target, prompt) == 0
+    mig = BlockMigrator(donor.blocks_per_slot)
+    moved = clone_prefix(donor, target, prompt, mig,
+                         device=jax.local_devices()[1])
+    assert moved == _predicted_hit(donor, prompt) > 0
+    assert _predicted_hit(target, prompt) == moved
+    # the adopted blocks serve a real request bit-identically
+    out = target.run([Request(uid=1, prompt=prompt, max_new_tokens=6)])
+    ref = PagedEngine(model, params, max_slots=2, kv_block_size=8,
+                      prefill_chunk=8).run(
+        [Request(uid=1, prompt=prompt, max_new_tokens=6)])
+    np.testing.assert_array_equal(out["results"][1], ref["results"][1])
+    assert out["stats"]["paged"]["shared_tokens"] >= moved
+
+
+# --- disaggregated engine: parity, compile-once, migration overlap -----
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_disagg_bit_identical_to_unified(kv_dtype):
+    model, params = _shared()
+    reqs = _mixed_trace()
+    uni = PagedEngine(model, params, max_slots=4, kv_block_size=8,
+                      prefill_chunk=8, kv_dtype=kv_dtype)
+    ref = uni.run([Request(uid=r.uid, prompt=r.prompt,
+                           max_new_tokens=r.max_new_tokens,
+                           arrival_tick=r.arrival_tick) for r in reqs])
+    dis = DisaggEngine(model, params, prefill_streams=2, max_slots=4,
+                       kv_block_size=8, prefill_chunk=8,
+                       kv_dtype=kv_dtype)
+    out = dis.run(reqs)
+    assert not out["errors"] and not ref["errors"]
+    for uid in ref["results"]:
+        np.testing.assert_array_equal(
+            out["results"][uid], ref["results"][uid],
+            err_msg=f"request {uid} diverged from the unified engine")
+    st = out["stats"]
+    assert st["migration"]["moves"] == len(reqs)
+    assert st["migration"]["hops"] == len(reqs)
+
+
+def test_disagg_multi_worker_parity_and_compile_once():
+    model, params = _shared()
+    reqs = _mixed_trace(n=12)
+    ref = PagedEngine(model, params, max_slots=4, kv_block_size=8,
+                      prefill_chunk=8).run(
+        [Request(uid=r.uid, prompt=r.prompt,
+                 max_new_tokens=r.max_new_tokens,
+                 arrival_tick=r.arrival_tick) for r in reqs])
+    dis = DisaggEngine(model, params, prefill_workers=2, decode_workers=2,
+                       prefill_streams=2, max_slots=2, kv_block_size=8,
+                       prefill_chunk=8)
+    out = dis.run(reqs)
+    assert not out["errors"]
+    for uid in ref["results"]:
+        np.testing.assert_array_equal(out["results"][uid],
+                                      ref["results"][uid])
+    st = out["stats"]
+    # compile-once PER WORKER: one batched-chunk trace per prefill
+    # worker (the counter sums workers), one decode trace per decode
+    # worker, one gather + one scatter for every migration in between
+    assert st["chunk_compiles"] == 2
+    assert st["decode_compiles"] == 1
+    assert all(v == 1 for v in st["decode_compiles_per_worker"])
+    assert st["migrate_gather_compiles"] == 1
+    assert st["migrate_scatter_compiles"] == 1
+
+
+def test_disagg_reset_reserves_without_retracing():
+    model, params = _shared()
+    reqs = _mixed_trace(n=6)
+    dis = DisaggEngine(model, params, prefill_streams=2, max_slots=2,
+                       kv_block_size=8, prefill_chunk=8)
+    first = dis.run(reqs)
+    dis.reset()
+    second = dis.run(reqs)
+    for uid in first["results"]:
+        np.testing.assert_array_equal(first["results"][uid],
+                                      second["results"][uid])
+    st = second["stats"]
+    assert st["chunk_compiles"] == 1 and st["decode_compiles"] == 1
+    assert st["restarts"] == 1
+
+
+def test_disagg_rejects_bad_topology():
+    model, params = _shared()
+    with pytest.raises(ValueError, match=">= 2 local devices"):
+        DisaggEngine(model, params, devices=jax.local_devices()[:1])
+    with pytest.raises(ValueError, match="need"):
+        DisaggEngine(model, params, prefill_workers=5, decode_workers=5,
+                     devices=jax.local_devices())
+    with pytest.raises(ValueError, match="at_rest"):
+        DisaggEngine(model, params, wire="int8", kv_dtype="int8")
+
+
+# --- preemption spill: device path == host path ------------------------
+
+
+def _contended_requests():
+    return [_req(0, prio=2, new=10), _req(1, prio=2, new=10),
+            _req(2, prio=0, tick=2, new=8), _req(3, prio=1, tick=2, new=8)]
+
+
+def test_device_spill_bit_identical_to_host_spill():
+    model, params = _shared()
+    reqs = _contended_requests()
+    host = PagedEngine(model, params, max_slots=2, kv_block_size=8,
+                       prefill_chunk=8, preempt=True, migrate="host")
+    h = host.run([Request(uid=r.uid, prompt=r.prompt,
+                          max_new_tokens=r.max_new_tokens,
+                          arrival_tick=r.arrival_tick,
+                          priority=r.priority) for r in reqs])
+    dev = PagedEngine(model, params, max_slots=2, kv_block_size=8,
+                      prefill_chunk=8, preempt=True, migrate="device")
+    d = dev.run(list(reqs))
+    hs, ds = h["stats"]["preempt"], d["stats"]["preempt"]
+    assert hs["spill_path"] == "host" and ds["spill_path"] == "device"
+    assert ds["preemptions"] > 0 and ds["still_spilled"] == 0
+    assert ds["migration_moves"] == ds["preemptions"] + ds["resumes"]
+    assert ds["migration_bytes"] > 0
+    for uid in h["results"]:
+        np.testing.assert_array_equal(
+            d["results"][uid], h["results"][uid],
+            err_msg=f"device-spill diverged from host-spill on {uid}")
+    # compile-once holds on the device path too
+    assert d["stats"]["decode_compiles"] == 1
+    assert ds["spill_compiles"] == 1 and ds["unspill_compiles"] == 1
+
+
+def test_device_spill_with_mesh_replicated_pools():
+    # regression: engines born under a training mesh hold pools
+    # committed across EVERY device; the resume hop lands the payload
+    # on the home device only, and the scatter jit rejects the mixed
+    # commitment unless resume re-places it to the pools' sharding
+    model, params = _shared()
+    reqs = _contended_requests()
+    ref = PagedEngine(model, params, max_slots=2, kv_block_size=8,
+                      prefill_chunk=8, preempt=True, migrate="host")
+    r = ref.run([Request(uid=q.uid, prompt=q.prompt,
+                         max_new_tokens=q.max_new_tokens,
+                         arrival_tick=q.arrival_tick,
+                         priority=q.priority) for q in reqs])
+    eng = PagedEngine(model, params, max_slots=2, kv_block_size=8,
+                      prefill_chunk=8, preempt=True, migrate="device")
+    mesh = jax.sharding.Mesh(np.asarray(jax.local_devices()), ("d",))
+    rep = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+    eng.pools = jax.device_put(eng.pools, rep)
+    d = eng.run(list(reqs))
+    assert d["stats"]["preempt"]["preemptions"] > 0
+    for uid in r["results"]:
+        np.testing.assert_array_equal(d["results"][uid],
+                                      r["results"][uid])
+
+
+def test_migrate_drop_recovered_by_supervisor_replay():
+    from distributed_deep_learning_tpu.serve.supervisor import (
+        ServeSupervisor)
+
+    model, params = _shared()
+    reqs = _contended_requests()
+    ref = PagedEngine(model, params, max_slots=2, kv_block_size=8,
+                      prefill_chunk=8, preempt=True, migrate="device")
+    clean = ref.run([Request(uid=r.uid, prompt=r.prompt,
+                             max_new_tokens=r.max_new_tokens,
+                             arrival_tick=r.arrival_tick,
+                             priority=r.priority) for r in reqs])
+    eng = PagedEngine(model, params, max_slots=2, kv_block_size=8,
+                      prefill_chunk=8, preempt=True, migrate="device")
+    calls = {"n": 0}
+
+    def corrupt_first(payload):
+        calls["n"] += 1
+        if calls["n"] > 1:
+            return payload
+        leaves, treedef = jax.tree.flatten(payload)
+        i = max(range(len(leaves)), key=lambda j: leaves[j].size)
+        leaves[i] = leaves[i].at[(0,) * leaves[i].ndim].add(1.0)
+        return jax.tree.unflatten(treedef, leaves)
+
+    eng._migrate_chaos = corrupt_first
+    out = ServeSupervisor(eng, retries=2).run(list(reqs))
+    st = out["stats"]
+    assert st["requests_lost"] == 0 and not out["errors"]
+    assert any(f["kind"] == "MigrationError" for f in st["faults"])
+    assert st["restarts"] >= 1
+    for uid in clean["results"]:
+        np.testing.assert_array_equal(
+            out["results"][uid], clean["results"][uid],
+            err_msg=f"post-replay output diverged on {uid}")
+    assert st["engine"]["decode_compiles"] == 1
+
+
+# --- offload helper ----------------------------------------------------
+
+
+def test_offload_commits_tree_to_device_bit_exact():
+    eng = _engine_with_committed()
+    target = jax.local_devices()[1]
+    moved = migrate_mod.offload(eng.pools, target, chunk_bytes=4096)
+    for a, b in zip(jax.tree.leaves(eng.pools), jax.tree.leaves(moved)):
+        assert list(b.devices()) == [target]
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --- CLI surface -------------------------------------------------------
+
+
+def test_cli_disagg_requires_paged():
+    with pytest.raises(SystemExit, match="requires --paged"):
+        parse_args(["--serve", "--disagg"])
+
+
+def test_cli_prefill_workers_validated():
+    with pytest.raises(SystemExit, match=">= 1"):
+        parse_args(["--serve", "--paged", "--prefill-workers", "0"])
+    with pytest.raises(SystemExit, match="requires --disagg"):
+        parse_args(["--serve", "--paged", "--prefill-workers", "2"])
+    # all 8 emulated devices on prefill would leave no decode pool
+    with pytest.raises(SystemExit, match="at least one decode"):
+        parse_args(["--serve", "--paged", "--disagg",
+                    "--prefill-workers", "8"])
+
+
+def test_cli_migrate_choices_and_accepts():
+    with pytest.raises(SystemExit):
+        parse_args(["--serve", "--paged", "--migrate", "npz"])
+    cfg = parse_args(["--serve", "--paged", "--disagg",
+                      "--prefill-workers", "2", "--migrate", "device"])
+    assert cfg.disagg and cfg.prefill_workers == 2
+    assert cfg.migrate == "device"
+    cfg = parse_args(["--serve", "--paged"])
+    assert not cfg.disagg and cfg.migrate == "host"
