@@ -610,6 +610,45 @@ def _fleet_resilience() -> dict | None:
         seed=int(os.environ.get("BENCH_CHAOS_SEED", "0")))
 
 
+def _fleet_rebalance() -> dict | None:
+    """Live fleet rebalancing drill (ISSUE 18): mid-request slot
+    evacuation off a degraded replica (digest-verified committed-KV
+    migration, bit-identical resume over fp32 AND int8 pools), a
+    corrupted evacuation payload rolled back by the digest with zero
+    loss, a target crash mid-evacuation aborted and ledger-replayed,
+    the elastic autoscaler's grow + drain-protocol shrink, and the
+    ``scale_thrash`` hysteresis gauntlet — the same code path
+    ``scripts/chaos_drill.py --scenario rebalance`` exposes.  Also runs
+    ``scripts/check_baselines.py`` (the band/section hygiene gate) and
+    folds its verdict into the record, so a band pointing at a
+    nonexistent bench section fails HERE, where the bands are used."""
+    import subprocess
+
+    from distributed_deep_learning_tpu.utils.chaos import (
+        run_rebalance_drill)
+
+    record = run_rebalance_drill(
+        seed=int(os.environ.get("BENCH_CHAOS_SEED", "0")))
+    check = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "scripts", "check_baselines.py")
+    try:
+        proc = subprocess.run([sys.executable, check],
+                              capture_output=True, text=True, timeout=120)
+        record["baseline_check_ok"] = proc.returncode == 0
+        if proc.returncode != 0:
+            record["baseline_check_errors"] = \
+                proc.stdout.strip().splitlines()[-8:]
+    except Exception as exc:  # the drill result stands on its own
+        record["baseline_check_ok"] = None
+        record["baseline_check_errors"] = [f"{type(exc).__name__}: {exc}"]
+    n_scen = [s for s in record["scenarios"].values()
+              if isinstance(s, dict)]
+    record["scenarios_passed_frac"] = (
+        sum(1 for s in n_scen if s.get("passed")) / len(n_scen)
+        if n_scen else None)
+    return record
+
+
 def _autotune() -> dict | None:
     """Auto-parallelism planner (ISSUE 5): search the plan lattice for the
     MLP workload on this box's devices and report best-vs-default measured
@@ -974,7 +1013,69 @@ REGRESSION_BANDS: dict[str, tuple[str, float]] = {
     "fleet_recovery_s_v1": ("lower_abs", 15.0),
     "fleet_requests_lost_v1": ("lower_abs", 0.5),
     "fleet_slo_attainment_v1": ("higher", 0.5),
+    # live rebalancing drill (ISSUE 18): ANY lost request during an
+    # evacuation / drain / rebalance fault is a broken chain, full
+    # stop; per-slot evacuation latency has an absolute ceiling (the
+    # tiny drill engine moves a handful of KV blocks — if that takes
+    # >1 s something structural regressed, whatever history says); an
+    # oscillating load must never move the fleet more than the
+    # hysteresis allows; and every drill scenario must pass.
+    "rebalance_requests_lost_v1": ("lower_abs", 0.5),
+    "rebalance_evac_ms_v1": ("lower_abs", 1000.0),
+    "rebalance_scale_events_v1": ("lower_abs", 6.5),
+    "rebalance_scenarios_passed_v1": ("higher", 0.05),
 }
+
+#: Band-key suffix -> the bench JSON-line section its metric rides in
+#: (ISSUE 18 satellite: ``scripts/check_baselines.py`` verifies every
+#: ``REGRESSION_BANDS`` entry names a section that actually exists, so
+#: a renamed/removed section can't leave its bands silently orphaned).
+BAND_SECTIONS: dict[str, str] = {
+    "resnet50_224_train_v1": "value",
+    "densenet_bc_train_v2": "secondary",
+    "causal_lm_2048_train_v1": "lm",
+    "serving_tokens_per_sec_v1": "serving",
+    "serving_prefix_hit_rate_v1": "serving",
+    "serving_slo_attainment_v1": "serving",
+    "serving_spec_acceptance_v1": "serving",
+    "serving_quant_kv_shrink_v1": "serving_quant",
+    "serving_quant_tokens_per_sec_v1": "serving_quant",
+    "serving_quant_logprob_drift_v1": "serving_quant",
+    "serving_disagg_speedup_v1": "serving_disagg",
+    "serving_disagg_tokens_per_sec_v1": "serving_disagg",
+    "serving_disagg_migration_gbps_v1": "serving_disagg",
+    "serving_disagg_itl_p99_ratio_v1": "serving_disagg",
+    "autotune_mlp_steps_per_sec_v1": "autotune",
+    "reshard_chunked_gb_per_sec_v1": "reshard",
+    "comm_int8_bytes_reduction_v1": "collectives",
+    "comm_overlap_fraction_v1": "collectives",
+    "obs_overhead_fraction_v1": "observability",
+    "obs_trace_overhead_fraction_v1": "observability",
+    "mem_model_error_v1": "memory_model",
+    "serve_resilience_detection_ticks_v1": "serve_resilience",
+    "serve_resilience_recovery_s_v1": "serve_resilience",
+    "serve_resilience_requests_lost_v1": "serve_resilience",
+    "serve_resilience_slo_attainment_v1": "serve_resilience",
+    "fleet_detection_ticks_v1": "fleet_resilience",
+    "fleet_recovery_s_v1": "fleet_resilience",
+    "fleet_requests_lost_v1": "fleet_resilience",
+    "fleet_slo_attainment_v1": "fleet_resilience",
+    "rebalance_requests_lost_v1": "fleet_rebalance",
+    "rebalance_evac_ms_v1": "fleet_rebalance",
+    "rebalance_scale_events_v1": "fleet_rebalance",
+    "rebalance_scenarios_passed_v1": "fleet_rebalance",
+}
+
+#: The section keys the bench JSON line actually carries (kept in sync
+#: with the ``line`` dict ``main`` assembles) — the target universe
+#: ``BAND_SECTIONS`` values must live in.
+SECTION_KEYS: frozenset = frozenset({
+    "value", "secondary", "lm", "input_pipeline", "serving",
+    "serving_quant", "serving_disagg", "resilience", "serve_resilience",
+    "fleet_resilience", "fleet_rebalance", "autotune", "reshard",
+    "observability", "memory_model", "collectives",
+    "flash_attention_speedup",
+})
 
 
 def regression_sentry(baselines: dict,
@@ -1394,6 +1495,34 @@ def main() -> int:
             print(f"bench: fleet-resilience section failed "
                   f"({type(exc).__name__}: {exc})", file=sys.stderr)
 
+    # --- fleet rebalance: live evacuation + elastic autoscaling -------------
+    fleet_rebalance = None
+    t_rebal = 220 if on_tpu else 180
+    if os.environ.get("BENCH_FLEET_REBALANCE", "1") != "0" and \
+            _time_left() < t_rebal:
+        print(f"bench: shedding fleet-rebalance section "
+              f"({_time_left():.0f}s left)", file=sys.stderr)
+    elif os.environ.get("BENCH_FLEET_REBALANCE", "1") != "0":
+        try:
+            with _section_timer("fleet_rebalance"):
+                fleet_rebalance = _fleet_rebalance()
+            for bkey, val in (
+                    ("rebalance_requests_lost_v1",
+                     fleet_rebalance.get("requests_lost_total")),
+                    ("rebalance_evac_ms_v1",
+                     fleet_rebalance.get("evac_ms_mean")),
+                    ("rebalance_scale_events_v1",
+                     fleet_rebalance.get("scale_events_total")),
+                    ("rebalance_scenarios_passed_v1",
+                     fleet_rebalance.get("scenarios_passed_frac"))):
+                if val is not None:
+                    fleet_rebalance[bkey.replace("_v1", "_vs_baseline")] = \
+                        round(_vs_baseline(baselines, f"{platform}:{bkey}",
+                                           float(val), base_path), 4)
+        except Exception as exc:
+            print(f"bench: fleet-rebalance section failed "
+                  f"({type(exc).__name__}: {exc})", file=sys.stderr)
+
     # --- autotune: planner search vs hand default ---------------------------
     autotune = None
     t_tune = 120 if on_tpu else 60
@@ -1539,6 +1668,7 @@ def main() -> int:
         "resilience": resilience,
         "serve_resilience": serve_resilience,
         "fleet_resilience": fleet_resilience,
+        "fleet_rebalance": fleet_rebalance,
         "autotune": autotune,
         "reshard": reshard,
         "observability": observability,
@@ -1671,8 +1801,8 @@ def orchestrate() -> int:
     shed = {"BENCH_SECONDARY": "0", "BENCH_LM": "0", "BENCH_INPUT": "0",
             "BENCH_ATTENTION": "0", "BENCH_SERVE": "0",
             "BENCH_RESILIENCE": "0", "BENCH_SERVE_RESILIENCE": "0",
-            "BENCH_RESHARD": "0", "BENCH_OBS": "0", "BENCH_COMM": "0",
-            "BENCH_MEMORY": "0"}
+            "BENCH_FLEET_REBALANCE": "0", "BENCH_RESHARD": "0",
+            "BENCH_OBS": "0", "BENCH_COMM": "0", "BENCH_MEMORY": "0"}
     plan: list[dict] = [{}] if pinned else [
         {"BENCH_BATCH_PER_CHIP": "256"},
         {"BENCH_BATCH_PER_CHIP": "128", **shed},

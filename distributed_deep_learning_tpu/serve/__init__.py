@@ -19,17 +19,24 @@ batched forward (:mod:`.spec`), and is driven by replayable traces with
 per-request SLOs (:mod:`.load`).
 """
 
+from distributed_deep_learning_tpu.serve.autoscaler import (FleetAutoscaler,
+                                                            PoolRebalancer)
 from distributed_deep_learning_tpu.serve.engine import (PagedEngine,
                                                         ServeEngine)
-from distributed_deep_learning_tpu.serve.fleet import (FleetRouter,
+from distributed_deep_learning_tpu.serve.fleet import (RETIRED, FleetRouter,
                                                        ReplicaCrash)
 from distributed_deep_learning_tpu.serve.load import (LoadSpec, make_load,
                                                       merge_slo_reports,
                                                       slo_report)
+from distributed_deep_learning_tpu.serve.rebalance import (EvacuationSignal,
+                                                           HotspotDetector,
+                                                           evacuate_slot)
 from distributed_deep_learning_tpu.serve.scheduler import (PagedScheduler,
                                                            Request,
                                                            SlotScheduler)
 
 __all__ = ["ServeEngine", "PagedEngine", "Request", "SlotScheduler",
            "PagedScheduler", "LoadSpec", "make_load", "slo_report",
-           "merge_slo_reports", "FleetRouter", "ReplicaCrash"]
+           "merge_slo_reports", "FleetRouter", "ReplicaCrash", "RETIRED",
+           "FleetAutoscaler", "PoolRebalancer", "EvacuationSignal",
+           "HotspotDetector", "evacuate_slot"]

@@ -166,6 +166,11 @@ class DisaggEngine:
                   eos_id=eos_id, temperature=temperature, top_k=top_k,
                   top_p=top_p, kv_dtype=kv_dtype,
                   weight_dtype=weight_dtype, donate=False)
+        # kept for role elasticity: reassign() rebuilds a worker engine
+        # with the OTHER role's geometry on the same device
+        self._engine_kw = dict(kw)
+        self._params = params
+        self._num_blocks = num_blocks
         # prefill pools keep the 2x default (or the caller's override)
         # so the prefix index can retain shared blocks across requests;
         # decode pools are EXACT-FIT — decode never prefix-matches, so
@@ -216,6 +221,7 @@ class DisaggEngine:
         self.kv_cache_bytes = sum(w.eng.kv_cache_bytes
                                   for w in self.prefill + self.decode)
         self.restarts = 0
+        self.pool_reassignments = 0
 
     # --- compiled program factory --------------------------------------
     def _make_batch_chunk(self, eng: PagedEngine):
@@ -325,6 +331,56 @@ class DisaggEngine:
                 generated=[item.pendtok])
             return True
         return False
+
+    def reassign(self, direction: str) -> bool:
+        """Move one IDLE worker's device between the prefill and decode
+        pools — role elasticity on sustained ``prefill_util`` skew (the
+        :class:`..serve.autoscaler.PoolRebalancer` decides, this
+        actuates).  The worker's engine is rebuilt with the new role's
+        geometry on the same device; its new programs compile on first
+        use (compile-once per worker, like any fresh worker).
+
+        ``"to_prefill"`` takes an idle decode worker (no live slots);
+        ``"to_decode"`` takes the newest idle prefill worker (prefill
+        worker ids index ``self.prefill`` and the batched-chunk program
+        list, so only the tail is removable).  Keeps >= 1 worker per
+        role and only moves between runs or while the worker is idle;
+        returns False when no worker is eligible."""
+        if direction not in ("to_prefill", "to_decode"):
+            raise ValueError(f"direction must be 'to_prefill' or "
+                             f"'to_decode', got {direction!r}")
+        kw = self._engine_kw
+        bs = self.block_size
+        plen = self.padded_len
+        if direction == "to_prefill":
+            if len(self.decode) < 2:
+                return False
+            victim = next((d for d in reversed(self.decode)
+                           if not d.slots), None)
+            if victim is None:
+                return False
+            self.decode.remove(victim)
+            eng = PagedEngine(self.model, self._params,
+                              max_slots=self.prefill_streams,
+                              num_blocks=self._num_blocks, **kw)
+            w = _Worker(len(self.prefill), eng, victim.device)
+            self.prefill.append(w)
+            self._bchunk.append(CountingJit(self._make_batch_chunk(eng)))
+        else:
+            if len(self.prefill) < 2 or self.prefill[-1].streams:
+                return False
+            victim = self.prefill.pop()
+            self._bchunk.pop()
+            eng = PagedEngine(self.model, self._params,
+                              max_slots=self.max_slots,
+                              num_blocks=self.max_slots * (plen // bs),
+                              **kw)
+            w = _Worker(len(self.decode), eng, victim.device)
+            self.decode.append(w)
+        self.kv_cache_bytes = sum(x.eng.kv_cache_bytes
+                                  for x in self.prefill + self.decode)
+        self.pool_reassignments += 1
+        return True
 
     def reset(self) -> None:
         """Warm restart: fresh pools/managers on every worker, same
@@ -657,6 +713,7 @@ class DisaggEngine:
             "migrate_gather_compiles": self.migrator._gather.traces,
             "migrate_scatter_compiles": self.migrator._scatter.traces,
             "restarts": self.restarts,
+            "pool_reassignments": self.pool_reassignments,
             "migration": mig,
             "paged": {
                 "prefill_workers": [w.eng.manager.stats()

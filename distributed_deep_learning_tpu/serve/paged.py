@@ -568,6 +568,27 @@ class BlockManager:
             self.on_event("adopt", blocks=len(ids))
         return start, ids
 
+    def unadopt(self, block_ids) -> int:
+        """Roll back a failed adoption: drop the index entries holding
+        the given freshly-adopted blocks and release the blocks back to
+        the free list.  The inverse of :meth:`adopt_prefix` for blocks
+        whose payload never arrived (a migration that tripped its
+        digest) — adopted blocks are held ONLY by the index (refcount
+        1), so removing the entry frees them and nothing downstream can
+        ever admit against the half-filled chain.  Returns how many
+        blocks were released."""
+        dropped = 0
+        for b in block_ids:
+            h = self.index.by_block.get(int(b))
+            if h is None:
+                continue
+            self.index.remove(h)
+            self._deref(int(b))
+            dropped += 1
+        if dropped and self.on_event is not None:
+            self.on_event("unadopt", blocks=dropped)
+        return dropped
+
     def prefix_summary(self) -> frozenset:
         """Cheap export of this manager's prefix-index coverage: the set
         of chain hashes currently indexed.  Each hash commits to an

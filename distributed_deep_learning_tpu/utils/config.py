@@ -219,10 +219,20 @@ class Config:
     spill_dir: str | None = None        # fleet serving: host directory for
                                         #   preempted-slot KV spill files
                                         #   (engine preemption audit trail)
+    autoscale: dict | None = None       # fleet serving: elastic replica-
+                                        #   count knobs (--autoscale
+                                        #   "min=1,max=4,patience=2")
+    evacuate_on: str = "off"            # fleet serving: live mid-request
+                                        #   slot evacuation trigger —
+                                        #   off | degraded | hotspot
+                                        #   (serve/rebalance.py)
     disagg: bool = False                # serving: disaggregate the replica
                                         #   into prefill + decode device
                                         #   pools joined by KV-block
                                         #   migration (serve/disagg.py)
+    pool_elastic: bool = False          # disagg serving: move a worker
+                                        #   between prefill/decode pools
+                                        #   on sustained prefill_util skew
     prefill_workers: int = 1            # serving: devices in the disagg
                                         #   prefill pool (the rest decode)
     migrate: str = "host"               # serving: where preempted KV
@@ -561,6 +571,26 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
                         "slot's spilled KV to DIR as an npz audit "
                         "trail (resume itself stays in host memory); "
                         "requires --priority-classes")
+    p.add_argument("--autoscale", type=str, default=None,
+                   metavar="K=V,...",
+                   help="fleet serving: elastic replica autoscaling, "
+                        "e.g. 'min=1,max=4,patience=2,cool=2' — "
+                        "patience consecutive hot rounds warm one new "
+                        "replica from the published weights (prefix-"
+                        "warmed via clone_prefix), cool consecutive "
+                        "cold rounds retire one through the drain "
+                        "protocol (stop placement, evacuate open "
+                        "slots, retire); requires --replicas > 1")
+    p.add_argument("--evacuate-on", dest="evacuate_on",
+                   choices=["off", "degraded", "hotspot"],
+                   default="off",
+                   help="fleet serving: live mid-request slot "
+                        "evacuation — on 'degraded' a health-degraded "
+                        "replica's open slots migrate (digest-verified "
+                        "committed KV) to healthy peers and resume "
+                        "bit-identically; 'hotspot' also evacuates on "
+                        "sustained per-replica latency skew; requires "
+                        "--replicas > 1")
     p.add_argument("--disagg", action="store_true",
                    help="serving: disaggregate the replica into a "
                         "prefill worker pool (chunked, compile-once per "
@@ -574,6 +604,14 @@ def build_parser(workload: str = "") -> argparse.ArgumentParser:
                         "pool; the remaining visible devices become "
                         "decode workers, so N must leave at least one "
                         "(requires --disagg)")
+    p.add_argument("--pool-elastic", dest="pool_elastic",
+                   action="store_true",
+                   help="disaggregated serving: after the run, judge "
+                        "the measured prefill_util against the pool "
+                        "rebalancer's hysteresis and reassign one idle "
+                        "worker between the prefill and decode pools "
+                        "when the skew is sustained (serve/autoscaler."
+                        "PoolRebalancer); requires --disagg")
     p.add_argument("--migrate", choices=["host", "device"],
                    default="host",
                    help="serving preemption: where a preempted slot's "
@@ -755,6 +793,52 @@ def parse_admission_arg(text: str | None,
         if v < lo:
             raise SystemExit(f"{flag}: {key}={val!r} must be >= {lo}")
         out[name] = v
+    return out
+
+
+#: ``--autoscale`` spec keys → (FleetAutoscaler kwarg, converter,
+#: minimum).  Same contract as ``_ADMISSION_KEYS``: a typo'd knob dies
+#: at the CLI boundary with the full key list, not as a TypeError from
+#: the autoscaler mid-serve.
+_AUTOSCALE_KEYS = {
+    "min": ("min_replicas", int, 1),
+    "max": ("max_replicas", int, 1),
+    "patience": ("patience", int, 1),
+    "cool": ("cool", int, 1),
+}
+
+
+def parse_autoscale_arg(text: str | None,
+                        flag: str = "--autoscale") -> dict | None:
+    """``--autoscale`` string → :class:`..serve.autoscaler.
+    FleetAutoscaler` kwargs, validated at parse time (mirrors
+    :func:`parse_admission_arg`).  Example:
+    ``"min=1,max=4,patience=2,cool=2"``."""
+    if not text:
+        return None
+    out: dict = {}
+    for part in text.split(","):
+        key, _, val = part.strip().partition("=")
+        if key not in _AUTOSCALE_KEYS:
+            raise SystemExit(
+                f"{flag}: unknown key {key!r} in entry {part!r}; known "
+                f"keys: {', '.join(sorted(_AUTOSCALE_KEYS))}")
+        name, conv, lo = _AUTOSCALE_KEYS[key]
+        if name in out:
+            raise SystemExit(f"{flag}: key {key!r} given twice")
+        try:
+            v = conv(val)
+        except ValueError:
+            raise SystemExit(f"{flag}: {key}={val!r} is not a valid "
+                             f"{conv.__name__}") from None
+        if v < lo:
+            raise SystemExit(f"{flag}: {key}={val!r} must be >= {lo}")
+        out[name] = v
+    if ("min_replicas" in out and "max_replicas" in out
+            and out["max_replicas"] < out["min_replicas"]):
+        raise SystemExit(f"{flag}: max={out['max_replicas']} < "
+                         f"min={out['min_replicas']} (the fleet cannot "
+                         "be smaller than its floor)")
     return out
 
 
@@ -982,6 +1066,18 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
                          "router's prefix-affinity placement and "
                          "zero-loss failover replay are built on the "
                          "paged engine's prefix index and ledger)")
+    # the rebalance tier (evacuation + autoscaling) lives in the fleet
+    # router: both flags are meaningless without a routed replica set
+    if args.autoscale and args.replicas < 2:
+        raise SystemExit("--autoscale requires --replicas > 1 (elastic "
+                         "sizing grows/shrinks the fleet router's "
+                         "replica set; a single un-routed engine has "
+                         "nothing to scale)")
+    if args.evacuate_on != "off" and args.replicas < 2:
+        raise SystemExit(f"--evacuate-on {args.evacuate_on} requires "
+                         "--replicas > 1 (a mid-request evacuation "
+                         "needs a healthy peer to migrate the open "
+                         "slots' committed KV to)")
     if args.priority_classes and not args.paged:
         raise SystemExit("--priority-classes requires --paged "
                          "(priority preemption spills and resumes "
@@ -1001,6 +1097,11 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
     if args.prefill_workers != 1 and not args.disagg:
         raise SystemExit("--prefill-workers requires --disagg (worker "
                          "pools only exist in disaggregated serving)")
+    if args.pool_elastic and not args.disagg:
+        raise SystemExit("--pool-elastic requires --disagg (role "
+                         "reassignment moves a worker between the "
+                         "prefill and decode pools, which only exist "
+                         "in disaggregated serving)")
     if args.disagg or args.migrate == "device":
         # these paths hard-require a device split, so resolve the
         # visible topology now and fail with the flag name instead of
@@ -1095,7 +1196,10 @@ def parse_args(argv: Sequence[str] | None = None, workload: str = "",
         replicas=args.replicas,
         priority_classes=parse_priority_classes(args.priority_classes),
         spill_dir=args.spill_dir,
+        autoscale=parse_autoscale_arg(args.autoscale),
+        evacuate_on=args.evacuate_on,
         disagg=args.disagg,
+        pool_elastic=args.pool_elastic,
         prefill_workers=args.prefill_workers,
         migrate=args.migrate,
         publish_weights=args.publish_weights,

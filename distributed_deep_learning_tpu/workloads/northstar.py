@@ -723,8 +723,19 @@ def _gpt_serve_fleet(config: Config, model, params, logger, trace,
     if config.admission is not None:
         admissions = {i: AdmissionController(**config.admission)
                       for i in range(config.replicas)}
+    autoscaler = engine_factory = None
+    if config.autoscale is not None:
+        from distributed_deep_learning_tpu.serve.autoscaler import (
+            FleetAutoscaler)
+
+        autoscaler = FleetAutoscaler(**config.autoscale)
+        # the published-weights seam: every grown replica serves the
+        # same params the fleet was launched with
+        engine_factory = lambda: PagedEngine(model, params, **engine_kw)  # noqa: E731
     flt = FleetRouter(engines, deadline_ms=config.serve_deadline_ms,
-                      retries=config.serve_retries, admissions=admissions)
+                      retries=config.serve_retries, admissions=admissions,
+                      evacuate_on=config.evacuate_on,
+                      autoscaler=autoscaler, engine_factory=engine_factory)
     out = flt.run(list(trace))
     st = out["stats"]
     tokens = sum(len(v) for v in out["results"].values())
@@ -741,6 +752,16 @@ def _gpt_serve_fleet(config: Config, model, params, logger, trace,
             line += " (" + ", ".join(
                 f"p{p}={s['slo_attainment']:.2f}" for p, s in
                 sorted(bp.items()) if s["slo_attainment"] is not None) + ")"
+    rb = st.get("rebalance")
+    if rb and rb["evacuate_on"] != "off":
+        line += (f", evacuated {rb['evacuated_slots']} slots "
+                 f"({rb['evacuated_tokens']} tokens, "
+                 f"{rb['rolled_back']} rolled back)")
+    asc = st.get("autoscaler")
+    if asc:
+        line += (f", scale events {asc['scale_events']} "
+                 f"(+{asc['grows']}/-{asc['shrinks']}, "
+                 f"{asc['replicas_final']} final)")
     logger.info(line)
 
 
@@ -779,6 +800,28 @@ def _gpt_serve_disagg(config: Config, model, params, logger, trace,
         f"prefill util {s['prefill_util']:.2f}, migrated "
         f"{mig['moves']} handoffs ({mig['wire_bytes']} B), compiles "
         f"chunk={s['chunk_compiles']} decode={s['decode_compiles']}")
+    if config.pool_elastic:
+        from distributed_deep_learning_tpu.serve.autoscaler import (
+            PoolRebalancer)
+
+        # judge the measured utilisation as a sustained signal: the
+        # run-level prefill_util IS the whole run's average, so feed it
+        # through the full patience window before actuating
+        bal = PoolRebalancer()
+        direction = None
+        for _ in range(bal.patience):
+            direction = bal.observe(s["prefill_util"])
+        if direction and eng.reassign(direction):
+            logger.info(
+                f"serve(disagg): pool-elastic moved one worker "
+                f"{direction.replace('_', ' ')} (prefill util "
+                f"{s['prefill_util']:.2f}); pools now "
+                f"{len(eng.prefill)}P+{len(eng.decode)}D")
+        else:
+            logger.info(
+                f"serve(disagg): pool-elastic held the split "
+                f"(prefill util {s['prefill_util']:.2f} inside the "
+                f"hysteresis band, or no idle worker to move)")
 
 
 def _gpt_post(config: Config, state, logger, dataset) -> None:

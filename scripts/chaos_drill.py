@@ -39,15 +39,28 @@ Four scenarios, selected with ``--scenario``:
   (``MigrationError``) and is recovered bit-identically by the
   supervisor's ledger replay, zero requests lost.
 
+* ``rebalance`` runs
+  :func:`distributed_deep_learning_tpu.utils.chaos.run_rebalance_drill`
+  — live fleet rebalancing: a degraded/hot replica's open slots are
+  evacuated MID-REQUEST to healthy peers (digest-verified committed-KV
+  migration, bit-identical resume, fp32 and int8 pools), a corrupted
+  evacuation payload (``evac_drop``) trips the digest and rolls the
+  destination back with zero loss, a target crash mid-evacuation
+  aborts and replays from the ledger, the elastic autoscaler grows a
+  prefix-warmed replica and shrinks it back through the drain
+  protocol, an oscillating ``scale_thrash`` load is damped by the
+  patience/cool hysteresis, and (given >= 3 devices) a disaggregated
+  engine reassigns a worker between the prefill and decode pools.
+
 All are CPU-runnable (the chains are host+XLA logic, not
 accelerator-specific); ``bench.py`` embeds the same records as its
-``resilience``, ``reshard``, ``serve_resilience`` and
-``fleet_resilience`` sections.
+``resilience``, ``reshard``, ``serve_resilience``,
+``fleet_resilience`` and ``fleet_rebalance`` sections.
 
 Usage::
 
     python scripts/chaos_drill.py [--seed N]
-        [--scenario resilience|shrink|serve|fleet]
+        [--scenario resilience|shrink|serve|fleet|rebalance]
 """
 
 import argparse
@@ -64,7 +77,7 @@ def main() -> int:
                    help="chaos plan seed (same seed = same faults, "
                         "bit-identical poison masks / kill sets)")
     p.add_argument("--scenario", choices=("resilience", "shrink", "serve",
-                                          "fleet"),
+                                          "fleet", "rebalance"),
                    default="resilience",
                    help="resilience: sentinel/corruption/restart chain; "
                         "shrink: kill workers, re-plan, reshard, continue; "
@@ -72,7 +85,9 @@ def main() -> int:
                         "swap + SLO admission under injected serve faults; "
                         "fleet: multi-replica failover, straggler "
                         "degradation, router flake, priority preemption "
-                        "with KV spill/resume")
+                        "with KV spill/resume; rebalance: mid-request "
+                        "slot evacuation, elastic autoscaling with drain "
+                        "protocol, rebalance fault gauntlet")
     args = p.parse_args()
 
     if args.scenario == "shrink":
@@ -96,6 +111,23 @@ def main() -> int:
             run_fleet_resilience_drill
 
         record = run_fleet_resilience_drill(seed=args.seed)
+        print(json.dumps(record))
+        return 0 if record["drill_passed"] else 1
+
+    if args.scenario == "rebalance":
+        # the pool-elasticity scenario needs >= 3 local devices for a
+        # reassignable disagg worker; force a small multi-device CPU
+        # host if the caller hasn't picked a topology (must land before
+        # jax imports)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
+        from distributed_deep_learning_tpu.utils.chaos import \
+            run_rebalance_drill
+
+        record = run_rebalance_drill(seed=args.seed)
         print(json.dumps(record))
         return 0 if record["drill_passed"] else 1
 

@@ -22,7 +22,12 @@ Checks:
 * every baseline value is a FINITE number (a NaN/inf or stringly value
   makes every future ratio vacuously pass) — non-scalar records are
   allowed only for allowlisted history keys (``tpu:flash_best_blocks``
-  is a block-shape list, not a metric).
+  is a block-shape list, not a metric);
+* every band names an existing bench JSON-line section through
+  ``bench.BAND_SECTIONS`` (a band whose section was renamed or removed
+  would keep "guarding" a metric nothing ever measures again) — the
+  ``fleet_rebalance`` bench section also runs this script and folds the
+  verdict into its record, so the hygiene gate rides the bench path.
 """
 
 from __future__ import annotations
@@ -48,8 +53,14 @@ _MODES = ("higher", "lower_abs")
 
 
 def check(baselines: dict, bands: dict,
-          allow_unbanded: frozenset = UNBANDED_ALLOWLIST) -> list[str]:
-    """All drift findings, empty when consistent (unit-testable core)."""
+          allow_unbanded: frozenset = UNBANDED_ALLOWLIST,
+          band_sections: dict | None = None,
+          section_keys: frozenset | None = None) -> list[str]:
+    """All drift findings, empty when consistent (unit-testable core).
+
+    ``band_sections`` / ``section_keys`` (both or neither) extend the
+    check to band->section hygiene: every band suffix must map to a
+    bench JSON-line section key that actually exists."""
     problems: list[str] = []
     for key in sorted(baselines):
         value = baselines[key]
@@ -95,6 +106,22 @@ def check(baselines: dict, bands: dict,
                 f"band {suffix!r} matches no baseline key (orphaned "
                 "band: metric renamed, or its section never calls "
                 "_vs_baseline)")
+        if band_sections is not None:
+            section = band_sections.get(suffix)
+            if section is None:
+                problems.append(
+                    f"band {suffix!r} has no BAND_SECTIONS entry (which "
+                    "bench section does its metric ride in?)")
+            elif section_keys is not None and section not in section_keys:
+                problems.append(
+                    f"band {suffix!r} maps to unknown bench section "
+                    f"{section!r} (not in SECTION_KEYS: section renamed "
+                    "or removed)")
+    if band_sections is not None:
+        for suffix in sorted(set(band_sections) - set(bands)):
+            problems.append(
+                f"BAND_SECTIONS entry {suffix!r} has no band (stale "
+                "mapping; remove it)")
     return problems
 
 
@@ -108,7 +135,9 @@ def main(argv=None) -> int:
 
     with open(path) as f:
         baselines = json.load(f)
-    problems = check(baselines, bench.REGRESSION_BANDS)
+    problems = check(baselines, bench.REGRESSION_BANDS,
+                     band_sections=getattr(bench, "BAND_SECTIONS", None),
+                     section_keys=getattr(bench, "SECTION_KEYS", None))
     for p in problems:
         print(f"check_baselines: {p}", file=sys.stderr)
     print(json.dumps({"baselines": len(baselines),

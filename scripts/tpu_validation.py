@@ -551,6 +551,36 @@ def serving_disagg(n_requests=48):
     return {"section": "serving_disagg", "on_tpu": on_tpu, **rec}
 
 
+def serving_rebalance(seed=0):
+    """Live fleet rebalancing on real hardware (ISSUE 18): the full
+    rebalance gauntlet — mid-request slot evacuation off a degraded
+    replica with digest-verified committed-KV migration (bit-identical
+    resume over fp32 AND int8 pools), ``evac_drop`` payload corruption
+    rolled back with zero loss, a target crash mid-evacuation aborted
+    and ledger-replayed, elastic autoscaling with the drain-protocol
+    shrink, ``scale_thrash`` hysteresis damping, and disaggregated
+    prefill/decode pool reassignment.  On TPU the evacuation path moves
+    committed KV over real ICI instead of emulated-host device_put —
+    the first measurement of mid-request drain latency at silicon
+    transfer rates."""
+    # the pool-elasticity scenario needs a reassignable third device:
+    # on the CPU smoke box force an emulated quad before backend init
+    # (no-op on TPU — the flag only shapes the host platform)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4").strip()
+
+    import jax
+
+    from distributed_deep_learning_tpu.utils.chaos import (
+        run_rebalance_drill)
+
+    on_tpu = jax.default_backend() == "tpu"
+    rec = run_rebalance_drill(seed=seed)
+    return {"section": "serving_rebalance", "on_tpu": on_tpu, **rec}
+
+
 def autotune(workload="gpt"):
     """Auto-parallelism planner on real hardware: search the plan lattice
     for a TPU-shaped LM geometry (small-GPT on TPU, toy on CPU smoke) and
@@ -703,7 +733,7 @@ def _record_flash_gate(result: dict) -> None:
 SECTIONS = ("flash_block_sweep", "flash_vs_dense", "gqa_speedup",
             "s2d_vs_plain", "batch_sweep", "lm_tokens", "serving",
             "serving_paged", "serving_quant", "serving_fleet",
-            "serving_disagg", "autotune", "reshard",
+            "serving_disagg", "serving_rebalance", "autotune", "reshard",
             "observability", "collectives", "mfu_diag", "lm_sweep")
 
 

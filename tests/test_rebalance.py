@@ -1,0 +1,508 @@
+"""Live fleet rebalancing (ISSUE 18): evacuation + elastic autoscaling.
+
+The load-bearing guarantees this PR adds on top of the fleet tier:
+
+* live mid-request slot evacuation — a degraded replica's open slots'
+  committed KV migrates (digest-verified) to a healthy peer and the
+  requests resume there BIT-IDENTICALLY, fp32 and int8 pools alike,
+  with ``requests_lost == 0``;
+* the evacuation rolls BACK on a corrupted payload: the digest trips
+  before anything scatters, the destination unadopts its adopted
+  chain, and the request replays cold from the ledger;
+* priority-0 requests evacuate LAST — a mid-drain failure strands the
+  cheapest work first;
+* the elastic autoscaler is a pure patience/cool hysteresis loop
+  (unit-tested with injected signal dicts) whose shrink path is the
+  drain protocol: stop placement → evacuate open slots → retire, with
+  ``decode_compiles`` still 1 on every survivor;
+* the disagg pool rebalancer applies the same hysteresis to
+  ``prefill_util`` skew;
+* the new chaos kinds (``evac_drop``, ``target_crash_mid_evac``,
+  ``scale_thrash``) are one-shot and replay-deterministic;
+* the new CLI knobs (``--autoscale``, ``--evacuate-on``,
+  ``--pool-elastic``) die at parse time with clear SystemExit
+  messages, never inside a run.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_deep_learning_tpu.models.transformer import CausalLM
+from distributed_deep_learning_tpu.serve.autoscaler import (FleetAutoscaler,
+                                                            PoolRebalancer)
+from distributed_deep_learning_tpu.serve.engine import PagedEngine
+from distributed_deep_learning_tpu.serve.fleet import (DEGRADED, HEALTHY,
+                                                       QUARANTINED, RETIRED,
+                                                       FleetRouter)
+from distributed_deep_learning_tpu.serve.load import (LoadSpec, make_load,
+                                                      merge_slo_reports,
+                                                      slo_report)
+from distributed_deep_learning_tpu.serve.rebalance import (EvacuationSignal,
+                                                           HotspotDetector)
+from distributed_deep_learning_tpu.serve.scheduler import Request
+from distributed_deep_learning_tpu.utils.chaos import ChaosEvent, ChaosPlan
+from distributed_deep_learning_tpu.utils.config import (parse_args,
+                                                        parse_autoscale_arg)
+
+MODEL = dict(vocab_size=61, num_layers=1, d_model=32, num_heads=4,
+             mlp_dim=64, max_len=48)
+
+SPEC = LoadSpec(n_requests=10, arrival="poisson", rate=2.0,
+                prompt_short=(4, 10), prompt_long=(12, 20),
+                long_frac=0.25, shared_prefix_len=8, shared_frac=0.5,
+                new_tokens=(4, 10), slo_ttft_ms=30000.0,
+                slo_e2e_ms=30000.0,
+                priority_classes=((0, 0.25), (1, 0.5), (2, 0.25)))
+
+
+@functools.lru_cache(maxsize=None)
+def _shared():
+    model = CausalLM(**MODEL)
+    toks = jnp.ones((1, 4), jnp.int32)
+    return model, model.init(jax.random.key(1), toks)["params"]
+
+
+def _engine(**kw):
+    model, params = _shared()
+    return PagedEngine(model, params, max_slots=3, kv_block_size=8,
+                       prefill_chunk=8, **kw)
+
+
+def _trace():
+    return make_load(SPEC, vocab_size=MODEL["vocab_size"], seed=3)
+
+
+@functools.lru_cache(maxsize=None)
+def _reference(kv_dtype=None):
+    """Clean-fleet run of the trace — greedy decode is deterministic and
+    batch/replica-invariant, so ONE cached reference serves every
+    rebalancing scenario (its engines are never reused)."""
+    kw = {} if kv_dtype is None else {"kv_dtype": kv_dtype}
+    out = FleetRouter([_engine(**kw) for _ in range(3)]).run(_trace())
+    assert not out["errors"] and out["stats"]["requests_lost"] == 0
+    return out
+
+
+def _req(uid, prio=1, new=6):
+    rng = np.random.default_rng(uid)
+    return Request(uid=uid,
+                   prompt=rng.integers(1, MODEL["vocab_size"],
+                                       size=6).astype(np.int64),
+                   max_new_tokens=new, priority=prio)
+
+
+def _assert_identical(out, ref):
+    assert set(out["results"]) == set(ref["results"])
+    for uid, toks in ref["results"].items():
+        assert np.array_equal(out["results"][uid], toks), \
+            f"request {uid} diverged after rebalancing"
+
+
+def _straggler_run(engines, extra=(), **kw):
+    plan = ChaosPlan(
+        [ChaosEvent(step=2, kind="replica_straggler", target=None,
+                    magnitude=5.0), *extra], seed=0)
+    out = FleetRouter(engines, chaos=plan, slow_tick_s=1.0,
+                      degrade_after=1, evacuate_on="degraded",
+                      **kw).run(_trace())
+    return plan, out
+
+
+# --- live evacuation: bit-identity, rollback, ordering ------------------
+
+
+@pytest.mark.parametrize("engine_kw", [{}, {"kv_dtype": "int8"}],
+                         ids=["fp32", "int8"])
+def test_evacuation_mid_request_bit_identical_zero_loss(engine_kw):
+    ref = _reference(engine_kw.get("kv_dtype"))
+    plan, out = _straggler_run([_engine(**engine_kw) for _ in range(3)])
+    st = out["stats"]
+    assert plan.fired, "the straggler never fired"
+    assert st["requests_lost"] == 0 and not out["errors"]
+    rb = st["rebalance"]
+    assert rb["evacuate_on"] == "degraded"
+    assert rb["evacuations"] >= 1
+    assert rb["evacuated_tokens"] > 0 and rb["evacuated_blocks"] > 0
+    assert rb["rolled_back"] == 0
+    _assert_identical(out, ref)
+    # drain = warm reset + adoption, never recompilation: no replica
+    # compiles decode twice (idle replicas legitimately stay at 0)
+    compiles = [v["decode_compiles"] for v in st["per_replica"].values()]
+    assert max(compiles) == 1 and all(c <= 1 for c in compiles)
+
+
+def test_evac_drop_rolls_back_and_replays_zero_loss():
+    ref = _reference()
+    plan, out = _straggler_run(
+        [_engine() for _ in range(3)],
+        extra=[ChaosEvent(step=1, kind="evac_drop")])
+    st = out["stats"]
+    assert any(k == "evac_drop" for _, k in plan.fired)
+    rb = st["rebalance"]
+    assert rb["rolled_back"] >= 1
+    assert st["requests_lost"] == 0 and not out["errors"]
+    _assert_identical(out, ref)
+
+
+def test_target_crash_mid_evac_aborts_and_replays_zero_loss():
+    ref = _reference()
+    plan, out = _straggler_run(
+        [_engine() for _ in range(3)],
+        extra=[ChaosEvent(step=1, kind="target_crash_mid_evac")])
+    st = out["stats"]
+    assert any(k == "target_crash_mid_evac" for _, k in plan.fired)
+    rb = st["rebalance"]
+    assert rb["aborted"] >= 1
+    assert QUARANTINED in st["health"].values()
+    assert st["requests_lost"] == 0 and not out["errors"]
+    _assert_identical(out, ref)
+
+
+def test_priority0_evacuates_last():
+    rt = FleetRouter([_engine(), _engine()])
+    for uid, prio in ((0, 0), (1, 2), (2, 1), (3, 0)):
+        rt.ledger.add(_req(uid, prio=prio))
+    records = rt.evacuate(rt.replicas[0], [0, 1, 2, 3], reason="drain")
+    assert [r["uid"] for r in records] == [1, 2, 0, 3]
+    prios = [rt.ledger.entries[r["uid"]].request.priority
+             for r in records]
+    assert prios[-2:] == [0, 0], "priority-0 slots must drain last"
+
+
+def test_evacuation_signal_carries_rid_and_reason():
+    sig = EvacuationSignal(2, "hotspot")
+    assert sig.rid == 2 and sig.reason == "hotspot"
+    assert "2" in str(sig) and "hotspot" in str(sig)
+
+
+def test_fleet_router_validates_evacuate_on():
+    with pytest.raises(ValueError, match="evacuate_on"):
+        FleetRouter([_engine()], evacuate_on="sometimes")
+
+
+# --- block-manager unadopt: the rollback primitive ----------------------
+
+
+def test_unadopt_restores_free_blocks_and_index():
+    src, dst = _engine(), _engine()
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, MODEL["vocab_size"], size=20).astype(np.int64)
+    src.run([Request(uid=0, prompt=prompt, max_new_tokens=6)])
+    sp = src.manager.match_prefix(prompt)
+    assert sp.full_blocks, "20-token prompt must yield full 8-token blocks"
+    free_before = len(dst.manager.free)
+    index_before = len(dst.manager.index.entries)
+    adopted = dst.manager.adopt_prefix(prompt, len(sp.full_blocks))
+    assert adopted is not None and adopted[1]
+    _, new_ids = adopted
+    assert len(dst.manager.free) == free_before - len(new_ids)
+    dropped = dst.manager.unadopt(new_ids)
+    assert dropped == len(new_ids)
+    assert len(dst.manager.free) == free_before
+    assert len(dst.manager.index.entries) == index_before
+    # unadopting already-freed ids is a no-op, not a crash
+    assert dst.manager.unadopt(new_ids) == 0
+
+
+# --- total-outage fallback prefers the least-struck replica -------------
+
+
+class _Recorder:
+    def __init__(self):
+        self.events = []
+
+    def record(self, kind, **kw):
+        self.events.append({"kind": kind, **kw})
+
+
+def test_total_outage_fallback_prefers_fewest_strikes():
+    rec = _Recorder()
+    rt = FleetRouter([_engine(), _engine()], recorder=rec)
+    rt.replicas[0].crashes = 2
+    rt.replicas[1].crashes = 1
+    for r in rt.replicas:
+        r.health = QUARANTINED
+    cands = rt._live_candidates()
+    assert [r.rid for r in cands] == [1, 0], \
+        "least-struck replica must lead the fallback pool"
+    assert all(r.health == DEGRADED for r in cands)
+    ev = [e for e in rec.events if e["kind"] == "fleet_fallback"]
+    assert len(ev) == 1 and ev[0]["preferred"] == 1
+    assert ev[0]["strikes"] == {0: 20, 1: 10}
+
+
+def test_retired_replica_excluded_even_from_fallback():
+    rt = FleetRouter([_engine(), _engine()])
+    rt.replicas[0].health = RETIRED
+    rt.replicas[1].health = QUARANTINED
+    cands = rt._live_candidates()
+    assert [r.rid for r in cands] == [1]
+
+
+# --- autoscaler: pure hysteresis over injected signals ------------------
+
+
+HOT = {"queue_depth": 100.0, "occupancy": 1.0}
+COLD = {"queue_depth": 0.0, "occupancy": 0.0}
+MILD = {"queue_depth": 1.0, "occupancy": 0.5}
+
+
+def test_autoscaler_patience_then_grow_and_cool_then_shrink():
+    a = FleetAutoscaler(min_replicas=1, max_replicas=4, patience=2, cool=3)
+    assert a.observe(HOT, 2) is None
+    assert a.observe(HOT, 2) == "grow"
+    # decision resets the streak: full patience again
+    assert a.observe(HOT, 3) is None
+    assert a.observe(COLD, 3) is None
+    assert a.observe(COLD, 3) is None
+    assert a.observe(COLD, 3) == "shrink"
+    assert a.stats()["grows"] == 1 and a.stats()["shrinks"] == 1
+
+
+def test_autoscaler_clamps_at_min_and_max():
+    a = FleetAutoscaler(min_replicas=2, max_replicas=2, patience=1, cool=1)
+    assert a.observe(HOT, 2) is None, "at max: never grow"
+    assert a.observe(COLD, 2) is None, "at min: never shrink"
+    assert a.events == []
+
+
+def test_autoscaler_streaks_are_mutually_exclusive():
+    a = FleetAutoscaler(patience=2, cool=2)
+    assert a.observe(HOT, 2) is None
+    assert a.observe(COLD, 2) is None      # hot streak zeroed
+    assert a.observe(HOT, 2) is None       # cold streak zeroed
+    assert a.observe(MILD, 2) is None      # both zeroed
+    assert a.observe(HOT, 2) is None
+    assert a.observe(HOT, 2) == "grow"
+
+
+def test_autoscaler_alternating_thrash_never_scales():
+    a = FleetAutoscaler(patience=2, cool=2)
+    for i in range(20):
+        assert a.observe(HOT if i % 2 == 0 else COLD, 2) is None
+    assert a.events == []
+
+
+def test_autoscaler_itl_signal_counts_as_hot():
+    a = FleetAutoscaler(patience=1, grow_itl_p99_s=0.5)
+    assert a.observe({"queue_depth": 0.0, "occupancy": 0.5,
+                      "itl_p99_s": 0.9}, 2) == "grow"
+
+
+@pytest.mark.parametrize("kw,msg", [
+    (dict(min_replicas=0), "min_replicas"),
+    (dict(min_replicas=3, max_replicas=2), "max_replicas"),
+    (dict(patience=0), "patience"),
+    (dict(cool=0), "cool"),
+])
+def test_autoscaler_validates_construction(kw, msg):
+    with pytest.raises(ValueError, match=msg):
+        FleetAutoscaler(**kw)
+
+
+def test_pool_rebalancer_hysteresis_and_validation():
+    b = PoolRebalancer(hi=0.9, lo=0.25, patience=2)
+    assert b.observe(0.95) is None
+    assert b.observe(0.95) == "to_prefill"
+    assert b.observe(0.1) is None
+    assert b.observe(0.1) == "to_decode"
+    assert b.observe(0.5) is None          # inside the band: reset
+    assert b.observe(0.95) is None
+    assert b.observe(0.5) is None
+    assert b.observe(0.95) is None, "band visit must reset the streak"
+    with pytest.raises(ValueError, match="lo"):
+        PoolRebalancer(hi=0.2, lo=0.5)
+    with pytest.raises(ValueError, match="patience"):
+        PoolRebalancer(patience=0)
+
+
+def test_hotspot_detector_flags_sustained_skew_only():
+    h = HotspotDetector(ratio=3.0, patience=2, min_ticks=4)
+    # a single replica is never a hotspot (no peers to compare with)
+    for _ in range(8):
+        assert not h.observe(0, 10.0)
+    h = HotspotDetector(ratio=3.0, patience=2, min_ticks=4)
+    for _ in range(8):
+        h.observe(1, 0.01)
+        h.observe(2, 0.01)
+    hits = [h.observe(0, 1.0) for _ in range(8)]
+    assert any(hits), "sustained 100x skew must be detected"
+    assert h.detections
+    with pytest.raises(ValueError, match="ratio"):
+        HotspotDetector(ratio=1.0)
+
+
+# --- drain-protocol scale-down + grow, zero loss ------------------------
+
+
+def test_autoscaler_grow_then_drain_shrink_zero_loss():
+    ref = _reference()
+    auto = FleetAutoscaler(min_replicas=3, max_replicas=4,
+                           patience=2, cool=2)
+    rt = FleetRouter([_engine() for _ in range(3)], autoscaler=auto,
+                     engine_factory=lambda: _engine())
+    for _ in range(2):
+        rt._autoscale_round(override="hot")
+    assert len(rt.replicas) == 4, "patience x hot must grow by one"
+    for _ in range(2):
+        rt._autoscale_round(override="cold")
+    live = [r for r in rt.replicas if r.health != RETIRED]
+    assert len(live) == 3, "cool x cold must drain one back"
+    retired = [r for r in rt.replicas if r.health == RETIRED]
+    assert len(retired) == 1 and not retired[0].draining
+    out = rt.run(_trace())
+    st = out["stats"]
+    assert st["requests_lost"] == 0 and not out["errors"]
+    _assert_identical(out, ref)
+    assert st["autoscaler"]["scale_events"] == 2
+    assert st["autoscaler"]["replicas_retired"] == 1
+    # the retired replica took no placements after its drain
+    assert retired[0].placements == 0
+    assert all(v["decode_compiles"] == 1
+               for rid, v in st["per_replica"].items()
+               if st["health"][rid] != RETIRED)
+
+
+def test_scale_down_never_drains_last_serving_replica():
+    auto = FleetAutoscaler(min_replicas=1, max_replicas=2,
+                           patience=1, cool=1)
+    rt = FleetRouter([_engine(), _engine()], autoscaler=auto)
+    rt.replicas[1].health = QUARANTINED
+    assert rt._scale_down() is None
+    assert rt.replicas[0].health == HEALTHY
+
+
+def test_scale_up_without_factory_is_recorded_noop():
+    rec = _Recorder()
+    auto = FleetAutoscaler(min_replicas=1, max_replicas=4, patience=1)
+    rt = FleetRouter([_engine()], autoscaler=auto, recorder=rec)
+    assert rt._scale_up() is None
+    assert len(rt.replicas) == 1
+    assert any(e["kind"] == "scale_up_skipped" for e in rec.events)
+
+
+# --- chaos: new kinds one-shot + deterministic --------------------------
+
+
+def test_chaos_event_accepts_rebalance_kinds():
+    for kind in ("evac_drop", "target_crash_mid_evac", "scale_thrash"):
+        ChaosEvent(step=1, kind=kind)
+
+
+def test_evac_corruptor_is_one_shot():
+    plan = ChaosPlan([ChaosEvent(step=2, kind="evac_drop")], seed=0)
+    corrupt = plan.evac_corruptor()
+    payload = [jnp.zeros((4, 4)), jnp.ones((2,))]
+    out1 = corrupt(payload)                       # call 1: not yet due
+    assert np.array_equal(np.asarray(out1[0]), np.zeros((4, 4)))
+    out2 = corrupt(payload)                       # call 2: fires once
+    assert not np.array_equal(np.asarray(out2[0]), np.zeros((4, 4)))
+    out3 = corrupt(payload)                       # spent
+    assert np.array_equal(np.asarray(out3[0]), np.zeros((4, 4)))
+    assert plan.fired == [(2, "evac_drop")]
+
+
+def test_evac_crash_hook_is_one_shot():
+    plan = ChaosPlan([ChaosEvent(step=2, kind="target_crash_mid_evac")],
+                     seed=0)
+    assert [plan.evac_crash_hook(s) for s in range(1, 5)] == \
+        [False, True, False, False]
+    assert plan.fired == [(2, "target_crash_mid_evac")]
+
+
+def test_scale_hook_oscillates_inside_window_then_closes():
+    plan = ChaosPlan([ChaosEvent(step=2, kind="scale_thrash",
+                                 magnitude=4.0)], seed=0)
+    seen = [plan.scale_hook(s) for s in range(8)]
+    assert seen == [None, None, "hot", "cold", "hot", "cold", None, None]
+    assert plan.fired == [(2, "scale_thrash")]
+    # window is spent: replaying earlier ticks stays quiet
+    assert plan.scale_hook(3) is None
+
+
+# --- merge_slo_reports keeps empty priority classes (satellite) ---------
+
+
+def test_merge_slo_reports_preserves_empty_priority_classes():
+    reqs = [Request(uid=u, prompt=np.ones(4, np.int64), max_new_tokens=4,
+                    slo_ttft_ms=100.0, slo_e2e_ms=1000.0, priority=1)
+            for u in range(2)]
+    rep = slo_report(reqs, {u: 0.01 for u in range(2)},
+                     {u: 0.01 for u in range(2)})
+    merged = merge_slo_reports([rep], classes={0, 1, 2})
+    assert sorted(merged["by_priority"]) == ["0", "1", "2"]
+    assert merged["by_priority"]["1"]["slo_checked"] == 2
+    for empty in ("0", "2"):
+        sub = merged["by_priority"][empty]
+        assert sub["slo_checked"] == 0
+        assert sub["slo_attainment"] is None
+    # shape stays stable even when NO replica reported anything
+    hollow = merge_slo_reports([], classes={0, 1})
+    assert sorted(hollow["by_priority"]) == ["0", "1"]
+
+
+def test_fleet_stats_slo_carries_every_trace_priority_class():
+    bp = _reference()["stats"]["slo"]["by_priority"]
+    want = {str(r.priority) for r in _trace()}
+    assert set(bp) >= want, \
+        "fleet SLO rollup dropped a priority class no replica reported"
+
+
+# --- CLI validation (satellite: parse-time, clear SystemExit) -----------
+
+
+@pytest.mark.parametrize("argv,msg", [
+    (["--autoscale", "min=1,max=4"], "--replicas"),
+    (["--paged", "--replicas", "3", "--autoscale", "depth=4"], "unknown"),
+    (["--paged", "--replicas", "3", "--autoscale", "min=1,min=2"],
+     "twice"),
+    (["--paged", "--replicas", "3", "--autoscale", "min=zz"], "int"),
+    (["--paged", "--replicas", "3", "--autoscale", "min=0"], ">= 1"),
+    (["--paged", "--replicas", "3", "--autoscale", "min=4,max=2"],
+     "max=2"),
+    (["--evacuate-on", "degraded"], "--replicas"),
+    (["--pool-elastic"], "--disagg"),
+])
+def test_cli_rejects_bad_rebalance_flags(argv, msg):
+    base = ["-l", "1", "-s", "32", "-e", "1", "-b", "16"]
+    with pytest.raises(SystemExit, match=msg.replace("-", r"\-")):
+        parse_args(base + argv, workload="gpt")
+
+
+def test_cli_rejects_unknown_evacuate_on_choice():
+    base = ["-l", "1", "-s", "32", "-e", "1", "-b", "16"]
+    with pytest.raises(SystemExit):
+        parse_args(base + ["--evacuate-on", "sometimes"], workload="gpt")
+
+
+def test_cli_accepts_rebalance_flags():
+    cfg = parse_args(["-l", "1", "-s", "32", "-e", "1", "-b", "16",
+                      "--paged", "--replicas", "3",
+                      "--autoscale", "min=1,max=4,patience=2,cool=3",
+                      "--evacuate-on", "hotspot"],
+                     workload="gpt")
+    assert cfg.autoscale == {"min_replicas": 1, "max_replicas": 4,
+                             "patience": 2, "cool": 3}
+    assert cfg.evacuate_on == "hotspot"
+    assert parse_autoscale_arg(None) is None
+    assert parse_autoscale_arg("min=2") == {"min_replicas": 2}
+
+
+# --- the full drill (slow: bench/chaos_drill surface) -------------------
+
+
+@pytest.mark.slow
+def test_rebalance_drill_passes():
+    from distributed_deep_learning_tpu.utils.chaos import (
+        run_rebalance_drill)
+
+    rec = run_rebalance_drill(seed=0)
+    assert rec["drill_passed"]
+    assert rec["requests_lost_total"] == 0
+    assert rec["scenarios"]["evac_drop"]["rolled_back"] >= 1
+    assert rec["scenarios"]["evacuation_fp32"]["bit_identical"]
+    assert rec["scenarios"]["evacuation_int8"]["bit_identical"]
